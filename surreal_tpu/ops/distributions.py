@@ -1,0 +1,70 @@
+"""Policy distributions as pure functions on parameter arrays.
+
+Capability parity with the reference's ``DiagGauss`` in
+``surreal/model/ppo_net.py`` (logp / KL / entropy / sample, SURVEY.md §2.1)
+plus a categorical head for the IMPALA/discrete path. Pure functions (not
+distribution objects) so they trace cleanly under jit/vmap/scan and live on
+device with no host round-trips.
+
+Shapes: ``mean``/``log_std``/``x`` are [..., act_dim]; reductions are over
+the last axis, returning [...].
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_LOG_2PI = math.log(2.0 * math.pi)
+
+
+# -- diagonal Gaussian ------------------------------------------------------
+
+def diag_gauss_sample(key: jax.Array, mean: jax.Array, log_std: jax.Array) -> jax.Array:
+    noise = jax.random.normal(key, mean.shape, dtype=mean.dtype)
+    return mean + jnp.exp(log_std) * noise
+
+
+def diag_gauss_logp(mean: jax.Array, log_std: jax.Array, x: jax.Array) -> jax.Array:
+    z = (x - mean) * jnp.exp(-log_std)
+    return -0.5 * jnp.sum(z * z + 2.0 * log_std + _LOG_2PI, axis=-1)
+
+
+def diag_gauss_entropy(log_std: jax.Array) -> jax.Array:
+    return jnp.sum(log_std + 0.5 * (_LOG_2PI + 1.0), axis=-1)
+
+
+def diag_gauss_kl(
+    mean_a: jax.Array, log_std_a: jax.Array, mean_b: jax.Array, log_std_b: jax.Array
+) -> jax.Array:
+    """KL(a || b) for diagonal Gaussians."""
+    var_a = jnp.exp(2.0 * log_std_a)
+    var_b = jnp.exp(2.0 * log_std_b)
+    return jnp.sum(
+        log_std_b - log_std_a + (var_a + (mean_a - mean_b) ** 2) / (2.0 * var_b) - 0.5,
+        axis=-1,
+    )
+
+
+# -- categorical ------------------------------------------------------------
+
+def categorical_sample(key: jax.Array, logits: jax.Array) -> jax.Array:
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def categorical_logp(logits: jax.Array, action: jax.Array) -> jax.Array:
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.take_along_axis(logp_all, action[..., None], axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def categorical_kl(logits_a: jax.Array, logits_b: jax.Array) -> jax.Array:
+    logp_a = jax.nn.log_softmax(logits_a, axis=-1)
+    logp_b = jax.nn.log_softmax(logits_b, axis=-1)
+    return jnp.sum(jnp.exp(logp_a) * (logp_a - logp_b), axis=-1)

@@ -1,0 +1,75 @@
+"""Running observation normalizer — the reference's ``ZFilter``
+(``surreal/model/z_filter.py``, SURVEY.md §2.1) re-designed as a pytree.
+
+The reference kept running mean/var on the learner, updated per batch, and
+broadcast it to actors through the parameter server. Here the state is a
+device-resident pytree updated inside the jitted train step (Chan's parallel
+variance merge, so arbitrary batch shapes fold in exactly), and "broadcast"
+is free: acting and learning share device memory. Under a data-parallel
+mesh the per-shard batch stats are psum-merged (see parallel/), keeping all
+replicas bitwise identical.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RunningStats(NamedTuple):
+    count: jax.Array  # scalar float (float64-unsafe platforms: float32 is fine for <1e7 steps)
+    mean: jax.Array   # [obs_dim...]
+    m2: jax.Array     # [obs_dim...] sum of squared deviations
+
+
+def init_stats(obs_shape: tuple[int, ...], dtype=jnp.float32) -> RunningStats:
+    return RunningStats(
+        count=jnp.asarray(1e-4, dtype),  # epsilon count avoids div-by-zero
+        mean=jnp.zeros(obs_shape, dtype),
+        m2=jnp.zeros(obs_shape, dtype),
+    )
+
+
+def update_stats(stats: RunningStats, batch: jax.Array) -> RunningStats:
+    """Fold a batch [..., obs_dim...] into the stats (leading axes reduced)."""
+    reduce_axes = tuple(range(batch.ndim - stats.mean.ndim))
+    batch = batch.astype(stats.mean.dtype)
+    b_count = jnp.asarray(
+        jnp.prod(jnp.asarray([batch.shape[i] for i in reduce_axes], jnp.int32))
+        if reduce_axes
+        else 1,
+        stats.count.dtype,
+    )
+    b_mean = jnp.mean(batch, axis=reduce_axes) if reduce_axes else batch
+    b_m2 = (
+        jnp.sum((batch - b_mean) ** 2, axis=reduce_axes)
+        if reduce_axes
+        else jnp.zeros_like(batch)
+    )
+    delta = b_mean - stats.mean
+    tot = stats.count + b_count
+    new_mean = stats.mean + delta * (b_count / tot)
+    new_m2 = stats.m2 + b_m2 + delta**2 * (stats.count * b_count / tot)
+    return RunningStats(count=tot, mean=new_mean, m2=new_m2)
+
+
+def merge_stats(a: RunningStats, b: RunningStats) -> RunningStats:
+    """Merge two independent stats (used for cross-replica psum-style merge)."""
+    tot = a.count + b.count
+    delta = b.mean - a.mean
+    return RunningStats(
+        count=tot,
+        mean=a.mean + delta * (b.count / tot),
+        m2=a.m2 + b.m2 + delta**2 * (a.count * b.count / tot),
+    )
+
+
+def normalize(stats: RunningStats, x: jax.Array, clip: float = 5.0) -> jax.Array:
+    std = jnp.sqrt(stats.m2 / stats.count + 1e-8)
+    return jnp.clip((x - stats.mean) / std, -clip, clip).astype(x.dtype)
+
+
+def variance(stats: RunningStats) -> jax.Array:
+    return stats.m2 / stats.count

@@ -1,0 +1,67 @@
+"""V-trace off-policy correction (IMPALA), as an on-device reverse scan.
+
+The reference shipped PPO and DDPG only; BASELINE config ⑤ (IMPALA/V-trace,
+SEED-RL batched inference) requires this regardless (SURVEY.md §6). Follows
+the IMPALA paper's recursion with truncated importance weights; everything
+is time-major [T, ...] and runs under jit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class VTraceOutput(NamedTuple):
+    vs: jax.Array            # [T, ...] V-trace value targets
+    pg_advantages: jax.Array  # [T, ...] policy-gradient advantages
+
+
+def vtrace(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+    clip_pg_rho: float = 1.0,
+) -> VTraceOutput:
+    """Args:
+      behaviour_logp: [T, ...] log pi_b(a_t | s_t) of the acting policy
+      target_logp:    [T, ...] log pi(a_t | s_t) of the learner policy
+      rewards:        [T, ...]
+      discounts:      [T, ...] gamma * (1 - done)
+      values:         [T+1, ...] learner value estimates incl. bootstrap
+      clip_rho/clip_c/clip_pg_rho: IS-weight truncation levels (rho_bar etc.)
+    """
+    log_rhos = target_logp - behaviour_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+
+    deltas = clipped_rhos * (rewards + discounts * values[1:] - values[:-1])
+
+    # vs_t - V_t = delta_t + gamma_t c_t (vs_{t+1} - V_{t+1}); reverse scan.
+    def step(carry, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * carry
+        return acc, acc
+
+    _, acc_rev = lax.scan(
+        step,
+        jnp.zeros_like(values[-1]),
+        (deltas[::-1], discounts[::-1], cs[::-1]),
+    )
+    vs_minus_v = acc_rev[::-1]
+    vs = vs_minus_v + values[:-1]
+
+    # pg advantage uses vs_{t+1}, bootstrapping the final step with V_T.
+    vs_next = jnp.concatenate([vs[1:], values[-1:]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    pg_advantages = clipped_pg_rhos * (rewards + discounts * vs_next - values[:-1])
+
+    return VTraceOutput(vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages))

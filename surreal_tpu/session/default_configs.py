@@ -1,0 +1,107 @@
+"""Base config trees (parity: reference ``surreal/session/default_configs.py``
+plus ``surreal/main/ppo_configs.py`` / ``ddpg_configs.py``, SURVEY.md §5.6).
+
+Three trees: learner / env / session. Algorithm-specific defaults live next
+to each learner (``surreal_tpu.learners.ppo.PPO_LEARNER_CONFIG`` etc.) and
+are ``extend()``-ed onto these bases.
+
+New relative to the reference: ``session.topology`` selects the device mesh
+(the reference instead wired ZMQ ports between processes), and
+``session.backend`` selects tpu/cpu.
+"""
+
+from __future__ import annotations
+
+from surreal_tpu.session.config import REQUIRED, Config
+
+BASE_LEARNER_CONFIG = Config(
+    algo=Config(
+        name=REQUIRED,  # 'ppo' | 'ddpg' | 'impala'
+        gamma=0.99,
+        n_step=1,
+        use_obs_filter=True,  # ZFilter running obs normalization
+    ),
+    model=Config(
+        actor_hidden=(64, 64),
+        critic_hidden=(64, 64),
+        activation="tanh",
+        cnn=Config(
+            enabled=False,          # pixel observations -> Nature-CNN stem
+            channels=(32, 64, 64),
+            kernels=(8, 4, 3),
+            strides=(4, 2, 1),
+            dense=512,
+        ),
+        dtype="float32",           # parameters; compute may be bfloat16
+        compute_dtype="bfloat16",  # MXU-friendly activations dtype
+    ),
+    optimizer=Config(
+        name="adam",
+        lr=3e-4,
+        max_grad_norm=0.5,
+        lr_schedule="constant",  # 'constant' | 'linear'
+    ),
+    replay=Config(
+        kind=REQUIRED,  # 'fifo' | 'uniform' | 'prioritized'
+        capacity=100_000,
+        start_sample_size=1_000,
+        batch_size=256,
+        # prioritized-replay knobs (ignored by other kinds)
+        priority_alpha=0.6,
+        priority_beta0=0.4,
+        priority_eps=1e-6,
+    ),
+)
+
+BASE_ENV_CONFIG = Config(
+    name=REQUIRED,        # 'jax:cartpole', 'gym:CartPole-v1', 'dm_control:cheetah-run', ...
+    num_envs=1,           # batched envs (vmap width on device, workers on host)
+    action_repeat=1,
+    frame_stack=1,
+    grayscale=False,
+    image_size=None,      # (H, W) resize for pixel obs
+    pixel_obs=False,
+    flatten_obs=True,     # concat dict obs into a single vector (state obs)
+    time_limit=None,      # None -> backend default
+    video=Config(enabled=False, dir=None, every_n_episodes=50),
+    seed=0,
+)
+
+BASE_SESSION_CONFIG = Config(
+    folder=REQUIRED,  # experiment directory (checkpoints, metrics, logs)
+    backend="tpu",    # 'tpu' | 'cpu' (cpu = host-simulated devices for tests)
+    topology=Config(
+        # mesh axes for the SPMD program; product must divide device count.
+        # dp = data parallel (gradient psum), tp = tensor parallel seam.
+        mesh=Config(dp=-1, tp=1),  # -1 -> use all remaining devices
+        num_env_workers=0,         # host-side env worker processes (0 = in-process)
+        envs_per_worker=32,
+    ),
+    total_env_steps=1_000_000,
+    checkpoint=Config(
+        every_n_iters=500,
+        keep_last=3,
+        keep_best=True,
+        restore_from=None,  # folder to resume from
+    ),
+    metrics=Config(
+        every_n_iters=10,
+        tensorboard=True,
+        console=True,
+    ),
+    eval=Config(
+        every_n_iters=100,
+        episodes=5,
+        mode="deterministic",  # 'deterministic' | 'stochastic'
+    ),
+    seed=0,
+)
+
+
+def base_config() -> Config:
+    """The full three-tree default bundle."""
+    return Config(
+        learner_config=BASE_LEARNER_CONFIG,
+        env_config=BASE_ENV_CONFIG,
+        session_config=BASE_SESSION_CONFIG,
+    )

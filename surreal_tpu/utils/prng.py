@@ -1,0 +1,25 @@
+"""PRNG plumbing.
+
+The reference relied on global numpy/torch seeding; JAX keys are explicit,
+so every stateful loop in this framework threads a key through its carry.
+These helpers keep that uniform.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def split_n(key: jax.Array, n: int) -> jax.Array:
+    """Split into ``n`` keys, shape [n, 2]."""
+    return jax.random.split(key, n)
+
+
+def fold_in_time(key: jax.Array, step) -> jax.Array:
+    """Derive a per-step key inside jitted loops without carrying splits."""
+    return jax.random.fold_in(key, jnp.asarray(step, jnp.uint32))

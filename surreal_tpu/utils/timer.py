@@ -1,0 +1,52 @@
+"""Wall-clock timing helpers for throughput accounting.
+
+Measurement fences use ``jax.block_until_ready`` only at boundaries so the
+async dispatch pipeline is never serialized inside the region being timed.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+import jax
+
+
+class RateTracker:
+    """Exponentially-smoothed items/sec (env steps, SGD iters)."""
+
+    def __init__(self, alpha: float = 0.1):
+        self.alpha = alpha
+        self._rate = None
+        self._last_t = None
+        self._last_count = 0
+
+    def update(self, total_count: int) -> float | None:
+        now = time.monotonic()
+        if self._last_t is not None:
+            dt = now - self._last_t
+            if dt > 0:
+                inst = (total_count - self._last_count) / dt
+                self._rate = (
+                    inst
+                    if self._rate is None
+                    else self.alpha * inst + (1 - self.alpha) * self._rate
+                )
+        self._last_t = now
+        self._last_count = total_count
+        return self._rate
+
+    @property
+    def rate(self) -> float | None:
+        return self._rate
+
+
+@contextmanager
+def device_timer(result_holder: dict, key: str, block_on=None):
+    """Time a region, blocking on ``block_on`` (a pytree of device arrays)
+    before stopping the clock so async dispatch doesn't hide the work."""
+    start = time.perf_counter()
+    yield
+    if block_on is not None:
+        jax.block_until_ready(block_on)
+    result_holder[key] = time.perf_counter() - start

@@ -1,0 +1,19 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+Must set XLA flags BEFORE jax initializes (SURVEY.md §4): every
+pmap/shard_map collective path is unit-testable this way without TPU
+hardware. Bench and production run on real TPU; tests are platform-CPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,249 @@
+"""Golden-value tests for the ops layer against slow numpy references
+(SURVEY.md §4: the reference had no test suite; this is the designed one)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+from surreal_tpu.ops import distributions as D
+from surreal_tpu.ops import returns as R
+from surreal_tpu.ops import running_stats as RS
+from surreal_tpu.ops.vtrace import vtrace
+
+
+# ---------- numpy reference implementations ----------
+
+def np_gae(rewards, discounts, values, lam):
+    T = len(rewards)
+    adv = np.zeros_like(rewards)
+    last = np.zeros_like(rewards[0])
+    for t in reversed(range(T)):
+        delta = rewards[t] + discounts[t] * values[t + 1] - values[t]
+        last = delta + discounts[t] * lam * last
+        adv[t] = last
+    return adv
+
+
+def np_nstep(rewards, discounts, boot_vals, n):
+    T = len(rewards)
+    out = np.zeros_like(rewards)
+    for t in range(T):
+        g = np.zeros_like(rewards[0])
+        disc = np.ones_like(discounts[0])
+        for k in range(n):
+            if t + k < T:
+                g = g + disc * rewards[t + k]
+                disc = disc * discounts[t + k]
+            else:
+                disc = disc * 0
+        idx = min(t + n - 1, T - 1)
+        out[t] = g + disc * boot_vals[idx]
+    return out
+
+
+def np_vtrace(blogp, tlogp, rewards, discounts, values, rho_bar, c_bar):
+    T = len(rewards)
+    rhos = np.exp(tlogp - blogp)
+    crho = np.minimum(rho_bar, rhos)
+    cs = np.minimum(c_bar, rhos)
+    vs = np.zeros_like(rewards)
+    acc = np.zeros_like(rewards[0])
+    for t in reversed(range(T)):
+        delta = crho[t] * (rewards[t] + discounts[t] * values[t + 1] - values[t])
+        acc = delta + discounts[t] * cs[t] * acc
+        vs[t] = acc + values[t]
+    vs_next = np.concatenate([vs[1:], values[-1:]], axis=0)
+    pg_adv = np.minimum(rho_bar, rhos) * (rewards + discounts * vs_next - values[:-1])
+    return vs, pg_adv
+
+
+def random_trajectory(rng, T=40, B=5):
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    done = rng.uniform(size=(T, B)) < 0.1
+    discounts = (0.99 * (1.0 - done)).astype(np.float32)
+    values = rng.normal(size=(T + 1, B)).astype(np.float32)
+    return rewards, discounts, values
+
+
+# ---------- GAE ----------
+
+def test_gae_matches_numpy():
+    rng = np.random.default_rng(0)
+    rewards, discounts, values = random_trajectory(rng)
+    adv, targets = R.gae_advantages(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(values), 0.95
+    )
+    expected = np_gae(rewards, discounts, values, 0.95)
+    np.testing.assert_allclose(adv, expected, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(targets, expected + values[:-1], rtol=1e-5, atol=1e-5)
+
+
+def test_gae_assoc_matches_scan():
+    rng = np.random.default_rng(1)
+    rewards, discounts, values = random_trajectory(rng, T=128)
+    a1, t1 = R.gae_advantages(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(values), 0.9
+    )
+    a2, t2 = R.gae_advantages_assoc(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(values), 0.9
+    )
+    np.testing.assert_allclose(a1, a2, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(t1, t2, rtol=1e-4, atol=1e-4)
+
+
+def test_gae_respects_episode_boundary():
+    # two episodes in one trajectory: advantage must not leak across done
+    T = 6
+    rewards = jnp.ones((T, 1))
+    discounts = jnp.asarray([0.9, 0.9, 0.0, 0.9, 0.9, 0.9])[:, None]
+    values = jnp.zeros((T + 1, 1))
+    adv, _ = R.gae_advantages(rewards, discounts, values, 1.0)
+    # with V=0 and lam=1, A_t = sum of discounted future rewards within episode
+    assert float(adv[2, 0]) == pytest.approx(1.0)  # terminal step sees only its reward
+    assert float(adv[0, 0]) == pytest.approx(1 + 0.9 + 0.81)
+
+
+# ---------- n-step ----------
+
+@pytest.mark.parametrize("n", [1, 3, 5])
+def test_nstep_matches_numpy(n):
+    rng = np.random.default_rng(2)
+    rewards, discounts, values = random_trajectory(rng, T=20, B=3)
+    boot = values[1:]
+    got = R.n_step_returns(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(boot), n
+    )
+    expected = np_nstep(rewards, discounts, boot, n)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+# ---------- V-trace ----------
+
+def test_vtrace_matches_numpy():
+    rng = np.random.default_rng(3)
+    rewards, discounts, values = random_trajectory(rng, T=30, B=4)
+    blogp = rng.normal(size=(30, 4)).astype(np.float32) * 0.5
+    tlogp = blogp + rng.normal(size=(30, 4)).astype(np.float32) * 0.2
+    out = vtrace(
+        jnp.asarray(blogp), jnp.asarray(tlogp), jnp.asarray(rewards),
+        jnp.asarray(discounts), jnp.asarray(values),
+    )
+    evs, epg = np_vtrace(blogp, tlogp, rewards, discounts, values, 1.0, 1.0)
+    np.testing.assert_allclose(out.vs, evs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out.pg_advantages, epg, rtol=1e-4, atol=1e-4)
+
+
+def test_vtrace_on_policy_reduces_to_gae_lam1():
+    # with behaviour == target and no clipping active, vs == GAE(lam=1) targets
+    rng = np.random.default_rng(4)
+    rewards, discounts, values = random_trajectory(rng, T=25, B=2)
+    logp = rng.normal(size=(25, 2)).astype(np.float32)
+    out = vtrace(
+        jnp.asarray(logp), jnp.asarray(logp), jnp.asarray(rewards),
+        jnp.asarray(discounts), jnp.asarray(values),
+    )
+    adv, targets = R.gae_advantages(
+        jnp.asarray(rewards), jnp.asarray(discounts), jnp.asarray(values), 1.0
+    )
+    np.testing.assert_allclose(out.vs, targets, rtol=1e-4, atol=1e-4)
+
+
+# ---------- distributions ----------
+
+def test_diag_gauss_logp_vs_scipy():
+    rng = np.random.default_rng(5)
+    mean = rng.normal(size=(7, 3)).astype(np.float32)
+    log_std = (rng.normal(size=(7, 3)) * 0.3).astype(np.float32)
+    x = rng.normal(size=(7, 3)).astype(np.float32)
+    got = D.diag_gauss_logp(jnp.asarray(mean), jnp.asarray(log_std), jnp.asarray(x))
+    expected = sps.norm.logpdf(x, mean, np.exp(log_std)).sum(-1)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+
+def test_diag_gauss_entropy_vs_scipy():
+    log_std = np.asarray([[0.1, -0.3, 0.7]], np.float32)
+    got = D.diag_gauss_entropy(jnp.asarray(log_std))
+    expected = sps.norm.entropy(0.0, np.exp(log_std)).sum(-1)
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+
+def test_diag_gauss_kl_zero_self():
+    mean = jnp.asarray([[0.3, -1.2]])
+    ls = jnp.asarray([[0.2, 0.1]])
+    np.testing.assert_allclose(D.diag_gauss_kl(mean, ls, mean, ls), 0.0, atol=1e-6)
+
+
+def test_diag_gauss_kl_known_value():
+    # KL(N(0,1) || N(1,1)) = 0.5
+    z = jnp.zeros((1, 1))
+    np.testing.assert_allclose(
+        D.diag_gauss_kl(z, z, jnp.ones((1, 1)), z), 0.5, rtol=1e-6
+    )
+
+
+def test_diag_gauss_sample_moments():
+    key = jax.random.PRNGKey(0)
+    mean = jnp.asarray([1.0, -2.0])
+    log_std = jnp.asarray([0.0, 0.5])
+    samples = jax.vmap(lambda k: D.diag_gauss_sample(k, mean, log_std))(
+        jax.random.split(key, 20000)
+    )
+    np.testing.assert_allclose(samples.mean(0), mean, atol=0.05)
+    np.testing.assert_allclose(samples.std(0), np.exp(log_std), atol=0.05)
+
+
+def test_categorical_logp_entropy():
+    logits = jnp.asarray([[1.0, 2.0, 0.5], [0.0, 0.0, 0.0]])
+    actions = jnp.asarray([1, 2])
+    got = D.categorical_logp(logits, actions)
+    probs = jax.nn.softmax(logits)
+    np.testing.assert_allclose(got[0], np.log(probs[0, 1]), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        D.categorical_entropy(logits)[1], np.log(3.0), rtol=1e-4
+    )
+    np.testing.assert_allclose(D.categorical_kl(logits, logits), 0.0, atol=1e-6)
+
+
+# ---------- running stats (ZFilter) ----------
+
+def test_running_stats_matches_numpy():
+    rng = np.random.default_rng(6)
+    stats = RS.init_stats((4,))
+    chunks = [rng.normal(loc=3.0, scale=2.0, size=(50, 4)).astype(np.float32) for _ in range(5)]
+    for c in chunks:
+        stats = RS.update_stats(stats, jnp.asarray(c))
+    allx = np.concatenate(chunks)
+    np.testing.assert_allclose(stats.mean, allx.mean(0), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(RS.variance(stats), allx.var(0), rtol=1e-2, atol=1e-2)
+
+
+def test_running_stats_merge():
+    rng = np.random.default_rng(7)
+    a_data = rng.normal(size=(100, 3)).astype(np.float32)
+    b_data = rng.normal(loc=2.0, size=(60, 3)).astype(np.float32)
+    sa = RS.update_stats(RS.init_stats((3,)), jnp.asarray(a_data))
+    sb = RS.update_stats(RS.init_stats((3,)), jnp.asarray(b_data))
+    merged = RS.merge_stats(sa, sb)
+    allx = np.concatenate([a_data, b_data])
+    np.testing.assert_allclose(merged.mean, allx.mean(0), rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(RS.variance(merged), allx.var(0), rtol=1e-2, atol=1e-2)
+
+
+def test_normalize_clips():
+    stats = RS.update_stats(
+        RS.init_stats((2,)), jnp.asarray(np.random.default_rng(8).normal(size=(1000, 2)), jnp.float32)
+    )
+    out = RS.normalize(stats, jnp.asarray([[100.0, -100.0]]), clip=5.0)
+    assert float(out[0, 0]) == pytest.approx(5.0)
+    assert float(out[0, 1]) == pytest.approx(-5.0)
+
+
+def test_running_stats_3d_batch():
+    # time-major [T, B, obs] batches must fold in across both leading axes
+    rng = np.random.default_rng(9)
+    data = rng.normal(size=(10, 8, 3)).astype(np.float32)
+    stats = RS.update_stats(RS.init_stats((3,)), jnp.asarray(data))
+    np.testing.assert_allclose(stats.mean, data.reshape(-1, 3).mean(0), rtol=1e-3, atol=1e-3)
+    assert float(stats.count) == pytest.approx(80, rel=1e-3)
