@@ -1,0 +1,26 @@
+"""Environment layer (parity: reference ``surreal/env/``, SURVEY.md §2.1
+L3): make_env factory, host adapters (gymnasium/dm_control), obs wrappers,
+video recording, plus the TPU-native on-device env family in ``jax/``.
+"""
+
+from surreal_tpu.envs.base import (
+    ArraySpec,
+    DiscreteSpec,
+    EnvSpecs,
+    HostEnv,
+    HostWrapper,
+    StepOutput,
+)
+from surreal_tpu.envs.factory import is_jax_env, make_env, register_jax_env
+
+__all__ = [
+    "ArraySpec",
+    "DiscreteSpec",
+    "EnvSpecs",
+    "HostEnv",
+    "HostWrapper",
+    "StepOutput",
+    "is_jax_env",
+    "make_env",
+    "register_jax_env",
+]

@@ -1,0 +1,7 @@
+"""Pure-functional on-device environments (TPU-native; no reference
+counterpart — replaces host C physics for the north-star throughput path).
+"""
+
+from surreal_tpu.envs.jax.base import AutoReset, AutoResetState, JaxEnv, batch_reset, batch_step
+
+__all__ = ["AutoReset", "AutoResetState", "JaxEnv", "batch_reset", "batch_step"]

@@ -1,0 +1,104 @@
+"""Pure-functional on-device environments.
+
+No counterpart exists in the reference — its envs were host-side C physics
+behind Python (SURVEY.md §2.3 MuJoCo row). This is the TPU-native addition
+that makes the north-star throughput possible: envs as jittable pure
+functions, vmapped over a batch axis, scanned over time, living entirely in
+HBM next to the policy.
+
+API (gymnax-style functional):
+    state, obs = env.reset(key, params)
+    state, obs, reward, done, info = env.step(state, action, params)
+
+``state`` is a pytree carrying everything including a PRNG key; auto-reset
+is composed on top via :class:`AutoReset` so trajectories stay fixed-shape
+under ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.envs.base import EnvSpecs
+
+
+class JaxEnv(abc.ABC):
+    """Single-env functional definition; batching is ``vmap``, not a loop."""
+
+    specs: EnvSpecs
+    max_episode_steps: int | None = None
+
+    @abc.abstractmethod
+    def reset(self, key: jax.Array):
+        """-> (state pytree, obs [obs_dim...])"""
+
+    @abc.abstractmethod
+    def step(self, state, action: jax.Array):
+        """-> (state, obs, reward scalar, done scalar bool, info dict)"""
+
+
+class AutoResetState(NamedTuple):
+    env_state: Any
+    key: jax.Array
+    step_count: jax.Array  # int32 scalar
+
+
+class AutoReset:
+    """Auto-reset + time-limit composition (parity: the reference's
+    max-step/time-limit wrapper, SURVEY.md §2.1 obs wrappers row), done the
+    functional way: on done, the returned obs IS the reset obs and the
+    episode's terminal obs is surfaced in ``info['terminal_obs']`` so
+    bootstrapping stays correct.
+    """
+
+    def __init__(self, env: JaxEnv, time_limit: int | None = None):
+        self.env = env
+        self.specs = env.specs
+        self.time_limit = time_limit or env.max_episode_steps
+
+    def reset(self, key: jax.Array):
+        key, sub = jax.random.split(key)
+        env_state, obs = self.env.reset(sub)
+        return AutoResetState(env_state, key, jnp.zeros((), jnp.int32)), obs
+
+    def step(self, state: AutoResetState, action: jax.Array):
+        env_state, obs, reward, done, info = self.env.step(state.env_state, action)
+        steps = state.step_count + 1
+        truncated = (
+            jnp.asarray(False)
+            if self.time_limit is None
+            else steps >= self.time_limit
+        )
+        done = jnp.logical_or(done, truncated)
+
+        key, sub = jax.random.split(state.key)
+        reset_state, reset_obs = self.env.reset(sub)
+
+        def pick(reset_leaf, cont_leaf):
+            return jnp.where(
+                jnp.reshape(done, (1,) * reset_leaf.ndim) if reset_leaf.ndim else done,
+                reset_leaf,
+                cont_leaf,
+            )
+
+        new_env_state = jax.tree.map(pick, reset_state, env_state)
+        new_obs = pick(reset_obs, obs)
+        new_steps = jnp.where(done, 0, steps)
+        info = dict(info)
+        info["terminal_obs"] = obs
+        info["truncated"] = truncated
+        return AutoResetState(new_env_state, key, new_steps), new_obs, reward, done, info
+
+
+def batch_reset(env, keys: jax.Array):
+    """vmap reset over a leading batch of keys."""
+    return jax.vmap(env.reset)(keys)
+
+
+def batch_step(env, state, actions: jax.Array):
+    """vmap step over the batch axis of state/actions."""
+    return jax.vmap(env.step)(state, actions)

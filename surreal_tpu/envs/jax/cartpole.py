@@ -1,0 +1,76 @@
+"""On-device CartPole-v1 (dynamics per Barto-Sutton-Anderson / the gymnasium
+implementation's constants). BASELINE config ① workload, runnable either via
+the gymnasium host adapter (``gym:CartPole-v1``) or fully on device as
+``jax:cartpole`` — both expose identical specs so configs are swappable.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.envs.base import ArraySpec, DiscreteSpec, EnvSpecs
+from surreal_tpu.envs.jax.base import JaxEnv
+
+_GRAVITY = 9.8
+_CART_MASS = 1.0
+_POLE_MASS = 0.1
+_TOTAL_MASS = _CART_MASS + _POLE_MASS
+_POLE_HALF_LEN = 0.5
+_POLEMASS_LEN = _POLE_MASS * _POLE_HALF_LEN
+_FORCE_MAG = 10.0
+_TAU = 0.02
+_THETA_LIMIT = 12 * 2 * jnp.pi / 360
+_X_LIMIT = 2.4
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array
+    x_dot: jax.Array
+    theta: jax.Array
+    theta_dot: jax.Array
+
+
+class CartPole(JaxEnv):
+    max_episode_steps = 500  # CartPole-v1 limit
+
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(4,), dtype=np.dtype(np.float32), name="state"),
+        action=DiscreteSpec(shape=(), dtype=np.dtype(np.int32), name="action", n=2),
+    )
+
+    def reset(self, key: jax.Array):
+        vals = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        state = CartPoleState(vals[0], vals[1], vals[2], vals[3])
+        return state, self._obs(state)
+
+    def step(self, state: CartPoleState, action: jax.Array):
+        force = jnp.where(action == 1, _FORCE_MAG, -_FORCE_MAG).astype(jnp.float32)
+        cos_t = jnp.cos(state.theta)
+        sin_t = jnp.sin(state.theta)
+        temp = (force + _POLEMASS_LEN * state.theta_dot**2 * sin_t) / _TOTAL_MASS
+        theta_acc = (_GRAVITY * sin_t - cos_t * temp) / (
+            _POLE_HALF_LEN * (4.0 / 3.0 - _POLE_MASS * cos_t**2 / _TOTAL_MASS)
+        )
+        x_acc = temp - _POLEMASS_LEN * theta_acc * cos_t / _TOTAL_MASS
+
+        new = CartPoleState(
+            x=state.x + _TAU * state.x_dot,
+            x_dot=state.x_dot + _TAU * x_acc,
+            theta=state.theta + _TAU * state.theta_dot,
+            theta_dot=state.theta_dot + _TAU * theta_acc,
+        )
+        done = (
+            (jnp.abs(new.x) > _X_LIMIT) | (jnp.abs(new.theta) > _THETA_LIMIT)
+        )
+        reward = jnp.ones((), jnp.float32)
+        return new, self._obs(new), reward, done, {}
+
+    @staticmethod
+    def _obs(state: CartPoleState) -> jax.Array:
+        return jnp.stack(
+            [state.x, state.x_dot, state.theta, state.theta_dot]
+        ).astype(jnp.float32)

@@ -1,0 +1,72 @@
+"""On-device Pendulum-v1 (continuous control smoke workload for the
+PPO/DDPG continuous paths before MuJoCo-class envs; same functional API as
+``jax:cartpole``). Dynamics/constants match gymnasium's Pendulum-v1 with
+the canonical [-1, 1] action box scaled to +-2 torque internally.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+from surreal_tpu.envs.jax.base import JaxEnv
+
+_MAX_SPEED = 8.0
+_MAX_TORQUE = 2.0
+_DT = 0.05
+_G = 10.0
+_M = 1.0
+_L = 1.0
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum(JaxEnv):
+    max_episode_steps = 200
+
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(3,), dtype=np.dtype(np.float32), name="state"),
+        action=ArraySpec(shape=(1,), dtype=np.dtype(np.float32), name="torque"),
+    )
+
+    def reset(self, key: jax.Array):
+        k1, k2 = jax.random.split(key)
+        theta = jax.random.uniform(k1, (), jnp.float32, -jnp.pi, jnp.pi)
+        theta_dot = jax.random.uniform(k2, (), jnp.float32, -1.0, 1.0)
+        state = PendulumState(theta, theta_dot)
+        return state, self._obs(state)
+
+    def step(self, state: PendulumState, action: jax.Array):
+        u = jnp.clip(action[0], -1.0, 1.0) * _MAX_TORQUE
+        cost = (
+            _angle_normalize(state.theta) ** 2
+            + 0.1 * state.theta_dot**2
+            + 0.001 * u**2
+        )
+        new_theta_dot = state.theta_dot + (
+            3.0 * _G / (2.0 * _L) * jnp.sin(state.theta) + 3.0 / (_M * _L**2) * u
+        ) * _DT
+        new_theta_dot = jnp.clip(new_theta_dot, -_MAX_SPEED, _MAX_SPEED)
+        new = PendulumState(
+            theta=state.theta + new_theta_dot * _DT,
+            theta_dot=new_theta_dot,
+        )
+        done = jnp.asarray(False)  # time-limit only (via AutoReset)
+        return new, self._obs(new), -cost.astype(jnp.float32), done, {}
+
+    @staticmethod
+    def _obs(state: PendulumState) -> jax.Array:
+        return jnp.stack(
+            [jnp.cos(state.theta), jnp.sin(state.theta), state.theta_dot]
+        ).astype(jnp.float32)

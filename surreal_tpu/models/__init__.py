@@ -1,0 +1,27 @@
+"""Neural-network models (parity: reference ``surreal/model/`` —
+``ppo_net.py``, ``ddpg_net.py``, ``model_builders.py``, ``z_filter.py``,
+``layer_norm.py``; SURVEY.md §2.1). The ZFilter equivalent lives in
+``surreal_tpu.ops.running_stats`` as a device pytree; LayerNorm is flax's.
+"""
+
+from surreal_tpu.models.encoders import ACTIVATIONS, MLP, NatureCNN, make_trunk
+from surreal_tpu.models.ppo_net import (
+    CategoricalOutput,
+    CategoricalPPOModel,
+    PolicyOutput,
+    PPOModel,
+)
+from surreal_tpu.models.ddpg_net import DDPGActor, DDPGCritic
+
+__all__ = [
+    "ACTIVATIONS",
+    "MLP",
+    "NatureCNN",
+    "make_trunk",
+    "PolicyOutput",
+    "PPOModel",
+    "CategoricalOutput",
+    "CategoricalPPOModel",
+    "DDPGActor",
+    "DDPGCritic",
+]

@@ -1,0 +1,86 @@
+"""DDPG actor and critic (parity: reference ``surreal/model/ddpg_net.py`` —
+deterministic tanh actor; critic with the action injected mid-network after
+the first obs layer; LayerNorm variants, SURVEY.md §2.1).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.models.encoders import ACTIVATIONS, MLP, make_trunk, orthogonal_init
+
+
+class DDPGActor(nn.Module):
+    """Deterministic policy: obs -> tanh-squashed action in [-1, 1]^act_dim.
+
+    Action-space scaling to env bounds happens in the env adapter so the
+    model is bounds-agnostic (all surreal_tpu continuous envs expose a
+    canonical [-1, 1] action box).
+    """
+
+    model_cfg: dict
+    act_dim: int
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        h = make_trunk(self.model_cfg, self.model_cfg["actor_hidden"])(obs)
+        a = nn.Dense(
+            self.act_dim,
+            kernel_init=nn.initializers.uniform(scale=3e-3),
+            dtype=h.dtype,
+            param_dtype=jnp.float32,
+        )(h).astype(jnp.float32)
+        return jnp.tanh(a)
+
+
+class DDPGCritic(nn.Module):
+    """Q(s, a): first layer sees obs only, action is concatenated before the
+    second layer — the reference's mid-network action injection, which keeps
+    the obs featurizer reusable and matches the original DDPG paper.
+    """
+
+    model_cfg: dict
+    use_layer_norm: bool = True
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        cfg = self.model_cfg
+        act = ACTIVATIONS[cfg["activation"]]
+        compute_dtype = jnp.dtype(cfg["compute_dtype"])
+        hidden = tuple(cfg["critic_hidden"])
+
+        if cfg["cnn"]["enabled"]:
+            h = make_trunk(cfg, hidden)(obs)
+        else:
+            h = obs.astype(compute_dtype)
+            h = nn.Dense(
+                hidden[0],
+                kernel_init=orthogonal_init(),
+                dtype=compute_dtype,
+                param_dtype=jnp.float32,
+            )(h)
+            if self.use_layer_norm:
+                h = nn.LayerNorm(dtype=compute_dtype, param_dtype=jnp.float32)(h)
+            h = act(h)
+
+        h = jnp.concatenate([h, action.astype(h.dtype)], axis=-1)
+        rest = hidden[1:] if not cfg["cnn"]["enabled"] else hidden
+        for width in rest:
+            h = nn.Dense(
+                width,
+                kernel_init=orthogonal_init(),
+                dtype=compute_dtype,
+                param_dtype=jnp.float32,
+            )(h)
+            if self.use_layer_norm:
+                h = nn.LayerNorm(dtype=compute_dtype, param_dtype=jnp.float32)(h)
+            h = act(h)
+        q = nn.Dense(
+            1,
+            kernel_init=nn.initializers.uniform(scale=3e-3),
+            dtype=compute_dtype,
+            param_dtype=jnp.float32,
+        )(h).astype(jnp.float32)
+        return q[..., 0]

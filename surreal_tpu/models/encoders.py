@@ -1,0 +1,127 @@
+"""Shared network stems (parity: reference ``surreal/model/model_builders.py``
+MLP/CNN builders, SURVEY.md §2.1), as flax modules.
+
+TPU notes: parameters are kept in ``param_dtype`` (float32) while
+activations run in ``compute_dtype`` (bfloat16 by default) so matmuls hit
+the MXU at full rate; heads cast back to float32 before anything
+numerically delicate (log-probs, losses).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ACTIVATIONS: dict[str, Callable[[jax.Array], jax.Array]] = {
+    "tanh": nn.tanh,
+    "relu": nn.relu,
+    "elu": nn.elu,
+    "gelu": nn.gelu,
+    "silu": nn.silu,
+}
+
+
+def orthogonal_init(scale: float = jnp.sqrt(2.0)):
+    return nn.initializers.orthogonal(scale)
+
+
+class MLP(nn.Module):
+    """Plain MLP trunk with orthogonal init (standard for PPO-family)."""
+
+    hidden: Sequence[int]
+    activation: str = "tanh"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+    use_layer_norm: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = ACTIVATIONS[self.activation]
+        x = x.astype(self.compute_dtype)
+        for width in self.hidden:
+            x = nn.Dense(
+                width,
+                kernel_init=orthogonal_init(),
+                dtype=self.compute_dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+            if self.use_layer_norm:
+                # reference shipped a LayerNorm module used in DDPG nets
+                # (surreal/model/layer_norm.py)
+                x = nn.LayerNorm(dtype=self.compute_dtype, param_dtype=self.param_dtype)(x)
+            x = act(x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """Nature-DQN conv stem for pixel observations (parity: the reference's
+    shared conv encoder for frame-stacked 84x84 pixels).
+
+    Input: [..., H, W, C] uint8 or float. uint8 is scaled to [0, 1] on
+    device so the host ships compact bytes over DCN.
+    """
+
+    channels: Sequence[int] = (32, 64, 64)
+    kernels: Sequence[int] = (8, 4, 3)
+    strides: Sequence[int] = (4, 2, 1)
+    dense: int = 512
+    activation: str = "relu"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = ACTIVATIONS[self.activation]
+        if x.dtype == jnp.uint8:
+            x = x.astype(self.compute_dtype) / 255.0
+        else:
+            x = x.astype(self.compute_dtype)
+        for ch, k, s in zip(self.channels, self.kernels, self.strides):
+            x = nn.Conv(
+                ch,
+                kernel_size=(k, k),
+                strides=(s, s),
+                padding="VALID",
+                kernel_init=orthogonal_init(),
+                dtype=self.compute_dtype,
+                param_dtype=self.param_dtype,
+            )(x)
+            x = act(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.Dense(
+            self.dense,
+            kernel_init=orthogonal_init(),
+            dtype=self.compute_dtype,
+            param_dtype=self.param_dtype,
+        )(x)
+        return act(x)
+
+
+def make_trunk(model_cfg, hidden: Sequence[int]) -> nn.Module:
+    """Build the obs trunk from a ``learner_config.model`` subtree: CNN stem
+    for pixel obs, MLP otherwise.
+
+    Item-style access throughout: flax module attributes holding Mappings
+    are converted to FrozenDict, which has no attribute access.
+    """
+    compute_dtype = jnp.dtype(model_cfg["compute_dtype"])
+    param_dtype = jnp.dtype(model_cfg["dtype"])
+    cnn = model_cfg["cnn"]
+    if cnn["enabled"]:
+        return NatureCNN(
+            channels=tuple(cnn["channels"]),
+            kernels=tuple(cnn["kernels"]),
+            strides=tuple(cnn["strides"]),
+            dense=cnn["dense"],
+            compute_dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+    return MLP(
+        hidden=tuple(hidden),
+        activation=model_cfg["activation"],
+        compute_dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )

@@ -1,0 +1,108 @@
+"""PPO actor-critic (parity: reference ``surreal/model/ppo_net.py`` — actor
+MLP with DiagGauss head + separate critic MLP, SURVEY.md §2.1).
+
+One flax module returns policy parameters and value in a single forward so
+acting and learning share the compiled computation; the distribution math
+itself lives in ``surreal_tpu.ops.distributions`` as pure functions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.models.encoders import MLP, make_trunk, orthogonal_init
+
+
+class PolicyOutput(NamedTuple):
+    mean: jax.Array      # [..., act_dim] float32
+    log_std: jax.Array   # [..., act_dim] float32 (state-independent)
+    value: jax.Array     # [...] float32
+
+
+class PPOModel(nn.Module):
+    """Continuous-control actor-critic with a diagonal-Gaussian head.
+
+    Separate actor/critic trunks (matching the reference's two MLPs); for
+    pixel obs a shared CNN stem feeds both heads — sharing the conv trunk is
+    what the reference did for pixels and it halves MXU work.
+    """
+
+    model_cfg: dict  # learner_config.model subtree (a Config)
+    act_dim: int
+    init_log_std: float = -0.5
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> PolicyOutput:
+        cfg = self.model_cfg
+        if cfg["cnn"]["enabled"]:
+            stem = make_trunk(cfg, cfg["actor_hidden"])(obs)
+            actor_h = stem
+            critic_h = stem
+        else:
+            actor_h = make_trunk(cfg, cfg["actor_hidden"])(obs)
+            critic_h = make_trunk(cfg, cfg["critic_hidden"])(obs)
+
+        mean = nn.Dense(
+            self.act_dim,
+            kernel_init=orthogonal_init(0.01),
+            dtype=actor_h.dtype,
+            param_dtype=jnp.float32,
+        )(actor_h).astype(jnp.float32)
+        log_std = self.param(
+            "log_std",
+            nn.initializers.constant(self.init_log_std),
+            (self.act_dim,),
+            jnp.float32,
+        )
+        log_std = jnp.broadcast_to(log_std, mean.shape)
+        value = nn.Dense(
+            1,
+            kernel_init=orthogonal_init(1.0),
+            dtype=critic_h.dtype,
+            param_dtype=jnp.float32,
+        )(critic_h).astype(jnp.float32)
+        return PolicyOutput(mean=mean, log_std=log_std, value=value[..., 0])
+
+
+class CategoricalOutput(NamedTuple):
+    logits: jax.Array  # [..., n_actions] float32
+    value: jax.Array   # [...] float32
+
+
+class CategoricalPPOModel(nn.Module):
+    """Discrete-action actor-critic (CartPole-class envs + the IMPALA path).
+
+    The reference only shipped continuous control; BASELINE configs ① and ⑤
+    need a categorical head (SURVEY.md §6).
+    """
+
+    model_cfg: dict
+    n_actions: int
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> CategoricalOutput:
+        cfg = self.model_cfg
+        if cfg["cnn"]["enabled"]:
+            stem = make_trunk(cfg, cfg["actor_hidden"])(obs)
+            actor_h = stem
+            critic_h = stem
+        else:
+            actor_h = make_trunk(cfg, cfg["actor_hidden"])(obs)
+            critic_h = make_trunk(cfg, cfg["critic_hidden"])(obs)
+        logits = nn.Dense(
+            self.n_actions,
+            kernel_init=orthogonal_init(0.01),
+            dtype=actor_h.dtype,
+            param_dtype=jnp.float32,
+        )(actor_h).astype(jnp.float32)
+        value = nn.Dense(
+            1,
+            kernel_init=orthogonal_init(1.0),
+            dtype=critic_h.dtype,
+            param_dtype=jnp.float32,
+        )(critic_h).astype(jnp.float32)
+        return CategoricalOutput(logits=logits, value=value[..., 0])

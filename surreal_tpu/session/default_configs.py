@@ -61,7 +61,8 @@ BASE_ENV_CONFIG = Config(
     grayscale=False,
     image_size=None,      # (H, W) resize for pixel obs
     pixel_obs=False,
-    flatten_obs=True,     # concat dict obs into a single vector (state obs)
+    flatten_obs=True,     # adapters always flatten dict obs to one vector;
+                          # kept for config parity (FilterWrapper/concat role)
     time_limit=None,      # None -> backend default
     video=Config(enabled=False, dir=None, every_n_episodes=50),
     seed=0,

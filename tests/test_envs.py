@@ -1,0 +1,161 @@
+"""Env layer tests: factory dispatch, adapters, wrappers, on-device envs
+(SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.envs import is_jax_env, make_env
+from surreal_tpu.envs.jax.base import AutoReset, batch_reset, batch_step
+from surreal_tpu.envs.jax.cartpole import CartPole
+from surreal_tpu.envs.jax.pendulum import Pendulum
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import BASE_ENV_CONFIG
+
+
+def env_cfg(**overrides):
+    return Config(overrides).extend(BASE_ENV_CONFIG)
+
+
+# -- on-device envs ---------------------------------------------------------
+
+def test_jax_cartpole_batched_rollout():
+    env = AutoReset(CartPole())
+    keys = jax.random.split(jax.random.key(0), 16)
+    state, obs = batch_reset(env, keys)
+    assert obs.shape == (16, 4)
+
+    @jax.jit
+    def rollout(state):
+        def step(carry, _):
+            st = carry
+            actions = jnp.ones((16,), jnp.int32)
+            st, obs, rew, done, info = batch_step(env, st, actions)
+            return st, (rew, done)
+
+        return jax.lax.scan(step, state, None, length=100)
+
+    _, (rews, dones) = rollout(state)
+    assert rews.shape == (100, 16)
+    assert bool(dones.any())  # constant action falls over well before 100 steps
+    assert float(rews.sum()) == 100 * 16  # reward 1 every step incl. terminal
+
+
+def test_jax_cartpole_autoreset_continues():
+    env = AutoReset(CartPole())
+    key = jax.random.key(1)
+    state, obs = env.reset(key)
+    done_seen = False
+    for _ in range(200):
+        state, obs, rew, done, info = env.step(state, jnp.ones((), jnp.int32))
+        if bool(done):
+            done_seen = True
+            # after done, obs is the fresh reset obs (small magnitudes)
+            assert float(jnp.abs(obs).max()) < 0.06
+            break
+    assert done_seen
+
+
+def test_jax_pendulum_time_limit_truncates():
+    env = AutoReset(Pendulum())
+    state, obs = env.reset(jax.random.key(0))
+
+    def step(carry, _):
+        st = carry
+        st, obs, rew, done, info = env.step(st, jnp.zeros((1,)))
+        return st, (done, info["truncated"])
+
+    _, (dones, truncs) = jax.lax.scan(step, state, None, length=200)
+    assert bool(dones[-1]) and bool(truncs[-1])
+    assert not bool(dones[:-1].any())
+
+
+# -- factory + host adapters ------------------------------------------------
+
+def test_make_env_jax_prefix():
+    env = make_env(env_cfg(name="jax:cartpole"))
+    assert is_jax_env(env)
+
+
+def test_make_env_rejects_missing_prefix():
+    with pytest.raises(ValueError):
+        make_env(env_cfg(name="CartPole-v1"))
+
+
+def test_gym_adapter_batched():
+    env = make_env(env_cfg(name="gym:CartPole-v1", num_envs=3))
+    obs = env.reset()
+    assert obs.shape == (3, 4)
+    out = env.step(np.array([0, 1, 0]))
+    assert out.obs.shape == (3, 4)
+    assert out.reward.shape == (3,)
+    assert out.done.dtype == bool
+    env.close()
+
+
+def test_gym_adapter_continuous_rescale():
+    env = make_env(env_cfg(name="gym:Pendulum-v1", num_envs=2))
+    env.reset()
+    out = env.step(np.array([[1.0], [-1.0]]))  # canonical bounds
+    assert out.obs.shape == (2, 3)
+    env.close()
+
+
+def test_episode_stats_wrapper_reports():
+    env = make_env(env_cfg(name="gym:CartPole-v1", num_envs=2))
+    env.reset(seed=0)
+    saw_stats = False
+    for _ in range(600):
+        out = env.step(np.array([0, 0]))  # always-left dies fast
+        if "episode_returns" in out.info:
+            saw_stats = True
+            assert (out.info["episode_returns"] > 0).all()
+            break
+    assert saw_stats
+    env.close()
+
+
+def test_frame_stack_wrapper():
+    from surreal_tpu.envs.gym_adapter import GymAdapter
+    from surreal_tpu.envs.wrappers import FrameStackWrapper
+
+    env = FrameStackWrapper(GymAdapter("CartPole-v1", num_envs=2), k=4)
+    obs = env.reset(seed=0)
+    assert obs.shape == (2, 16)
+    first = obs[:, :4]
+    # initially all k slots hold the reset obs
+    assert np.allclose(obs[:, 4:8], first)
+    out = env.step(np.array([0, 1]))
+    # newest frame occupies the last slot, older shifted left
+    assert np.allclose(out.obs[:, :4], first)
+    env.close()
+
+
+def test_grayscale_wrapper_shapes():
+    from surreal_tpu.envs.base import ArraySpec, DiscreteSpec, EnvSpecs, HostEnv, StepOutput
+    from surreal_tpu.envs.wrappers import GrayscaleWrapper
+
+    class FakePixelEnv(HostEnv):
+        num_envs = 2
+        specs = EnvSpecs(
+            obs=ArraySpec(shape=(8, 8, 3), dtype=np.dtype(np.uint8)),
+            action=DiscreteSpec(shape=(), dtype=np.dtype(np.int32), n=2),
+        )
+
+        def reset(self, seed=None):
+            return np.full((2, 8, 8, 3), 128, np.uint8)
+
+        def step(self, actions):
+            return StepOutput(
+                obs=np.full((2, 8, 8, 3), 64, np.uint8),
+                reward=np.zeros(2, np.float32),
+                done=np.zeros(2, bool),
+                info={},
+            )
+
+    env = GrayscaleWrapper(FakePixelEnv())
+    assert env.specs.obs.shape == (8, 8, 1)
+    obs = env.reset()
+    assert obs.shape == (2, 8, 8, 1)
+    assert obs.dtype == np.uint8

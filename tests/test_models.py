@@ -1,0 +1,91 @@
+"""Model layer shape/dtype/init tests (SURVEY.md §4 unit-test plan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.models import (
+    CategoricalPPOModel,
+    DDPGActor,
+    DDPGCritic,
+    PPOModel,
+)
+from surreal_tpu.session.default_configs import BASE_LEARNER_CONFIG
+
+
+def model_cfg(**overrides):
+    cfg = BASE_LEARNER_CONFIG.model
+    from surreal_tpu.session.config import Config
+
+    return Config(overrides).extend(cfg) if overrides else cfg
+
+
+def test_ppo_model_shapes_and_dtypes():
+    model = PPOModel(model_cfg=model_cfg(), act_dim=6)
+    obs = jnp.zeros((32, 17))
+    params = model.init(jax.random.key(0), obs)
+    out = jax.jit(model.apply)(params, obs)
+    assert out.mean.shape == (32, 6)
+    assert out.log_std.shape == (32, 6)
+    assert out.value.shape == (32,)
+    # heads must be float32 regardless of bfloat16 compute
+    assert out.mean.dtype == jnp.float32
+    assert out.value.dtype == jnp.float32
+    # params stored in float32
+    leaves = jax.tree.leaves(params)
+    assert all(l.dtype == jnp.float32 for l in leaves)
+
+
+def test_ppo_model_cnn_pixels():
+    cfg = model_cfg(cnn={"enabled": True})
+    model = PPOModel(model_cfg=cfg, act_dim=4)
+    obs = jnp.zeros((8, 84, 84, 12), jnp.uint8)  # frame-stacked pixels
+    params = model.init(jax.random.key(0), obs)
+    out = model.apply(params, obs)
+    assert out.mean.shape == (8, 4)
+    assert out.value.shape == (8,)
+
+
+def test_categorical_model():
+    model = CategoricalPPOModel(model_cfg=model_cfg(), n_actions=2)
+    obs = jnp.zeros((16, 4))
+    params = model.init(jax.random.key(0), obs)
+    out = model.apply(params, obs)
+    assert out.logits.shape == (16, 2)
+    assert out.value.shape == (16,)
+
+
+def test_ddpg_actor_bounds():
+    model = DDPGActor(model_cfg=model_cfg(activation="relu"), act_dim=3)
+    obs = jax.random.normal(jax.random.key(1), (64, 10)) * 100.0
+    params = model.init(jax.random.key(0), obs)
+    act = model.apply(params, obs)
+    assert act.shape == (64, 3)
+    assert bool(jnp.all(jnp.abs(act) <= 1.0))
+
+
+def test_ddpg_critic_action_injection():
+    model = DDPGCritic(model_cfg=model_cfg(activation="relu"))
+    obs = jnp.zeros((64, 10))
+    act = jnp.zeros((64, 3))
+    params = model.init(jax.random.key(0), obs, act)
+    q = model.apply(params, obs, act)
+    assert q.shape == (64,)
+    # Q must actually depend on the action (mid-network injection wired up)
+    q2 = model.apply(params, obs, jnp.ones_like(act))
+    assert not np.allclose(np.asarray(q), np.asarray(q2))
+
+
+def test_ppo_model_works_under_vmap_scan():
+    """Acting path: model must trace under vmap+scan (SEED-style rollout)."""
+    model = PPOModel(model_cfg=model_cfg(), act_dim=2)
+    obs = jnp.zeros((4, 8))
+    params = model.init(jax.random.key(0), obs)
+
+    def step(carry, _):
+        out = model.apply(params, carry)
+        return carry, out.value
+
+    _, values = jax.lax.scan(step, obs, None, length=3)
+    assert values.shape == (3, 4)
