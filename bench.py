@@ -1,0 +1,82 @@
+"""Headline benchmark: env steps/sec/chip for fused on-device PPO.
+
+Workload: PPO on the on-device CartPole (BASELINE config ① family) with a
+large vmapped env batch — rollout + GAE + minibatched SGD all in one
+compiled program per iteration, dispatched asynchronously so the tunnel /
+dispatch latency overlaps device compute. Will move to the MJX
+BlockLifting-class env (jax:lift) once it lands, matching BASELINE.json's
+"Robosuite env steps/sec/chip" metric definition.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is value / 100_000 — the north-star ">=100k env steps/sec/chip"
+from BASELINE.json (the reference itself published no numbers; SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+NUM_ENVS = 1024
+HORIZON = 128
+WARMUP_ITERS = 2
+MEASURE_ITERS = 20
+NORTH_STAR = 100_000.0
+
+
+def main() -> None:
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=HORIZON, epochs=4, num_minibatches=4),
+        ),
+        env_config=Config(name="jax:cartpole", num_envs=NUM_ENVS),
+        session_config=Config(
+            folder="/tmp/bench_ppo",
+            metrics=Config(every_n_iters=10_000),  # no host syncs mid-bench
+        ),
+    ).extend(base_config())
+
+    trainer = Trainer(cfg)
+    key = jax.random.key(0)
+    key, init_key, env_key = jax.random.split(key, 3)
+    state = trainer.learner.init(init_key)
+    from surreal_tpu.launch.rollout import init_device_carry
+
+    carry = init_device_carry(trainer.env, env_key, NUM_ENVS)
+
+    # warmup (compile) -- not measured
+    for _ in range(WARMUP_ITERS):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_ITERS):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    steps = MEASURE_ITERS * NUM_ENVS * HORIZON
+    sps = steps / dt
+    print(
+        json.dumps(
+            {
+                "metric": "env_steps_per_sec_per_chip_ppo_fused_cartpole",
+                "value": round(sps, 1),
+                "unit": "env_steps/s/chip",
+                "vs_baseline": round(sps / NORTH_STAR, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

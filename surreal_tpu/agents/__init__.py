@@ -1,0 +1,6 @@
+"""Agent layer (parity: reference ``surreal/agent/``, SURVEY.md §2.1)."""
+
+from surreal_tpu.agents.base import AGENT_MODES, Agent
+from surreal_tpu.agents.ppo_agent import PPOAgent
+
+__all__ = ["AGENT_MODES", "Agent", "PPOAgent"]

@@ -1,0 +1,37 @@
+"""Agent layer (parity: reference ``surreal/agent/base.py`` — ``act(obs)``,
+agent modes, periodic parameter fetch; SURVEY.md §2.1).
+
+In the reference an Agent was a separate OS process holding a torch model
+copy, polling the parameter server. Here an Agent is a *view over learner
+state*: it binds (learner, mode) and acts through the learner's pure
+``act`` fn. "Parameter fetch" collapses to passing the current (or an
+intentionally stale snapshot of the) LearnerState — the staleness seam for
+the async SEED-style serving path lives in ``distributed/``, not here.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from surreal_tpu.learners.base import EVAL_DETERMINISTIC, EVAL_STOCHASTIC, TRAINING, Learner
+
+AGENT_MODES = (TRAINING, EVAL_DETERMINISTIC, EVAL_STOCHASTIC)
+
+
+class Agent:
+    """Mode-bound acting view; ``act`` is jittable (self is static)."""
+
+    def __init__(self, learner: Learner, mode: str = TRAINING):
+        if mode not in AGENT_MODES:
+            raise ValueError(f"mode {mode!r} not in {AGENT_MODES}")
+        self.learner = learner
+        self.mode = mode
+
+    def act(self, state, obs: jax.Array, key: jax.Array):
+        """Batched action + behavior ``action_info`` from learner state."""
+        return self.learner.act(state, obs, key, self.mode)
+
+    def eval_view(self, deterministic: bool = True) -> "Agent":
+        return type(self)(
+            self.learner, EVAL_DETERMINISTIC if deterministic else EVAL_STOCHASTIC
+        )

@@ -1,0 +1,19 @@
+"""PPO agent (parity: reference ``surreal/agent/ppo_agent.py`` — samples
+from the diagonal-Gaussian (or categorical) policy and returns the
+behavior-policy ``action_info`` attached to experience; SURVEY.md §2.1).
+
+All behavior lives in :class:`PPOLearner.act`; this class exists as the
+named capability seam (and carries the stochastic/deterministic mode
+selection for eval workers).
+"""
+
+from __future__ import annotations
+
+from surreal_tpu.agents.base import Agent
+from surreal_tpu.learners.base import TRAINING
+from surreal_tpu.learners.ppo import PPOLearner
+
+
+class PPOAgent(Agent):
+    def __init__(self, learner: PPOLearner, mode: str = TRAINING):
+        super().__init__(learner, mode)

@@ -68,10 +68,13 @@ class AutoReset:
     def step(self, state: AutoResetState, action: jax.Array):
         env_state, obs, reward, done, info = self.env.step(state.env_state, action)
         steps = state.step_count + 1
+        # genuine termination takes precedence: a step that both terminates
+        # and hits the limit is terminated, NOT truncated (else bootstrapping
+        # would wrongly credit gamma*V(terminal) to a real failure state)
         truncated = (
             jnp.asarray(False)
             if self.time_limit is None
-            else steps >= self.time_limit
+            else jnp.logical_and(steps >= self.time_limit, jnp.logical_not(done))
         )
         done = jnp.logical_or(done, truncated)
 

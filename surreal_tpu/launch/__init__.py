@@ -1,0 +1,6 @@
+"""Entry points / training drivers (parity: reference ``surreal/main/`` +
+``surreal/launch/``, SURVEY.md §2.1 main-dispatch row)."""
+
+from surreal_tpu.launch.trainer import Trainer
+
+__all__ = ["Trainer"]

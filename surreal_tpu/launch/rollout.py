@@ -1,0 +1,157 @@
+"""Trajectory collection — the rebuild of the reference's actor rollout
+loop (``run_agent``, SURVEY.md §3.2) minus the processes.
+
+Two collectors, same batch contract (see learners/ppo.py docstring):
+
+- :func:`device_rollout` — envs ARE device arrays (``jax:*``): one
+  ``lax.scan`` over the horizon, vmapped over B envs, inside the same jit
+  as the learner step if the caller fuses them. This is the path where the
+  reference needed 1000 actor processes and ZMQ; here it is one XLA loop.
+- :func:`host_rollout` — host envs (gym/dm_control/robosuite-class): the
+  SEED-RL pattern, batched obs -> one jitted ``act`` -> batched env.step;
+  per-step numpy dicts are aggregated (learners/aggregator.py) into one
+  ``device_put``.
+
+Episode returns are tracked in-band: ``ep_return`` is nonzero only at done
+steps (sum over the finished episode), so metrics need no side channel out
+of jit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.envs.base import HostEnv
+from surreal_tpu.envs.jax.base import AutoReset, batch_step
+from surreal_tpu.learners.base import TRAINING, Learner
+from surreal_tpu.learners.aggregator import multistep_batch
+
+
+class RolloutCarry(NamedTuple):
+    env_state: Any
+    obs: jax.Array
+    ep_return: jax.Array  # [B] running episode return
+    ep_length: jax.Array  # [B] running episode length
+
+
+def device_rollout(
+    env: AutoReset,
+    learner: Learner,
+    state,
+    carry: RolloutCarry,
+    key: jax.Array,
+    horizon: int,
+):
+    """Collect ``horizon`` steps across B batched on-device envs.
+
+    Returns (new_carry, batch) — batch has the learner batch contract plus
+    ``ep_return``/``ep_done`` for metrics. Pure; callers jit it (usually
+    fused with ``learner.learn``).
+    """
+
+    def step(scan_carry, step_key):
+        c: RolloutCarry = scan_carry
+        akey, skey = jax.random.split(step_key)
+        action, info = learner.act(state, c.obs, akey, TRAINING)
+        env_state, obs2, reward, done, step_info = batch_step(
+            env, c.env_state, action
+        )
+        terminal_obs = step_info["terminal_obs"]
+        truncated = step_info["truncated"]
+        # obs2 is post-reset at dones; the true successor is terminal_obs
+        done_b = done.reshape(done.shape + (1,) * (obs2.ndim - done.ndim))
+        next_obs = jnp.where(done_b, terminal_obs, obs2)
+        ep_return = c.ep_return + reward
+        ep_length = c.ep_length + 1
+        trans = {
+            "obs": c.obs,
+            "next_obs": next_obs,
+            "action": action,
+            "reward": reward,
+            "done": done,
+            "terminated": jnp.logical_and(done, jnp.logical_not(truncated)),
+            "behavior_logp": info["logp"],
+            "behavior": {
+                k: v for k, v in info.items() if k in ("mean", "log_std", "logits")
+            },
+            "ep_return": jnp.where(done, ep_return, 0.0),
+            "ep_done": done,
+        }
+        new_c = RolloutCarry(
+            env_state=env_state,
+            obs=obs2,
+            ep_return=jnp.where(done, 0.0, ep_return),
+            ep_length=jnp.where(done, 0, ep_length),
+        )
+        return new_c, trans
+
+    keys = jax.random.split(key, horizon)
+    new_carry, batch = jax.lax.scan(step, carry, keys)
+    return new_carry, batch
+
+
+def init_device_carry(env: AutoReset, key: jax.Array, num_envs: int) -> RolloutCarry:
+    keys = jax.random.split(key, num_envs)
+    env_state, obs = jax.vmap(env.reset)(keys)
+    return RolloutCarry(
+        env_state=env_state,
+        obs=obs,
+        ep_return=jnp.zeros(num_envs, jnp.float32),
+        ep_length=jnp.zeros(num_envs, jnp.int32),
+    )
+
+
+def host_rollout(
+    env: HostEnv,
+    act_fn: Callable,  # pre-jitted (state, obs, key) -> (action, info)
+    state,
+    obs: np.ndarray,
+    key: jax.Array,
+    horizon: int,
+):
+    """Collect ``horizon`` steps from a batched host env (SEED-RL pattern:
+    one device inference per step for ALL envs, not per-env processes).
+
+    Returns (last_obs, batch, episode_stats) with batch on device.
+    """
+    steps = []
+    ep_returns: list[float] = []
+    ep_lengths: list[int] = []
+    for _ in range(horizon):
+        key, akey = jax.random.split(key)
+        action, info = act_fn(state, jnp.asarray(obs), akey)
+        action_np = np.asarray(action)
+        out = env.step(action_np)
+        terminal_obs = out.info.get("terminal_obs")
+        truncated = np.asarray(out.info.get("truncated", np.zeros(len(out.done), bool)))
+        if terminal_obs is not None and out.done.any():
+            done_b = out.done.reshape(out.done.shape + (1,) * (out.obs.ndim - 1))
+            next_obs = np.where(done_b, terminal_obs, out.obs)
+        else:
+            next_obs = out.obs
+        steps.append(
+            {
+                "obs": obs,
+                "next_obs": next_obs,
+                "action": action_np,
+                "reward": out.reward,
+                "done": out.done,
+                "terminated": out.done & ~truncated,
+                "behavior_logp": np.asarray(info["logp"]),
+                "behavior": {
+                    k: np.asarray(v)
+                    for k, v in info.items()
+                    if k in ("mean", "log_std", "logits")
+                },
+            }
+        )
+        if "episode_returns" in out.info:
+            ep_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
+            ep_lengths.extend(np.asarray(out.info["episode_lengths"]).tolist())
+        obs = out.obs
+    batch = multistep_batch(steps)
+    return obs, batch, {"returns": ep_returns, "lengths": ep_lengths}

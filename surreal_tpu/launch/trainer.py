@@ -1,0 +1,172 @@
+"""Training driver — the rebuild of the reference's learner main loop +
+actor pool + replay plumbing as ONE program (SURVEY.md §3.4 and the
+BASELINE north star: "learner+actors as one SPMD program instead of
+separate ZMQ processes").
+
+Two drive modes, chosen by the env family:
+
+- **device mode** (``jax:*`` envs): collect-horizon + learn are fused into
+  a single jitted ``train_iter``; the host only reads metrics every
+  ``metrics.every_n_iters`` iterations (one device->host sync) — the hot
+  loop never leaves the chip.
+- **host mode** (gym/dm_control): SEED-style batched stepping on the host
+  feeding jitted ``learn`` — the reference's actor/replay/learner triangle
+  collapsed into an alternation.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.envs import is_jax_env, make_env
+from surreal_tpu.launch.rollout import (
+    RolloutCarry,
+    device_rollout,
+    host_rollout,
+    init_device_carry,
+)
+from surreal_tpu.learners import build_learner
+from surreal_tpu.session.tracker import PeriodicTracker
+
+
+class Trainer:
+    """On-policy trainer (PPO-family); off-policy (DDPG) routes through
+    the replay layer instead of consuming rollouts directly."""
+
+    def __init__(self, config):
+        self.config = config
+        self.env = make_env(config.env_config)
+        self.learner = build_learner(config.learner_config, self.env.specs)
+        # the learner holds the fully-extended tree (algo defaults applied)
+        self.horizon = self.learner.config.algo.horizon
+        self.num_envs = config.env_config.num_envs
+        self.device_mode = is_jax_env(self.env)
+        self.seed = config.session_config.seed
+
+        if self.device_mode:
+            topo = config.session_config.topology
+            from surreal_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(topo)
+            if self.mesh.size > 1:
+                from surreal_tpu.parallel.dp import dp_train_iter
+
+                if self.num_envs % self.mesh.shape["dp"] != 0:
+                    raise ValueError(
+                        f"num_envs={self.num_envs} must be divisible by the "
+                        f"dp axis size {self.mesh.shape['dp']}"
+                    )
+                self._train_iter = dp_train_iter(
+                    self._device_train_iter, self.learner, self.mesh
+                )
+            else:
+                self._train_iter = jax.jit(self._device_train_iter)
+        else:
+            self.mesh = None
+            self._act = jax.jit(partial(self.learner.act, mode="training"))
+            self._learn = jax.jit(self.learner.learn)
+
+    # -- device (fused) path -------------------------------------------------
+    def _device_train_iter(
+        self, state, carry: RolloutCarry, key: jax.Array, axis_name=None
+    ):
+        ckey, lkey = jax.random.split(key)
+        carry, batch = device_rollout(
+            self.env, self.learner, state, carry, ckey, self.horizon
+        )
+        learn_batch = {
+            k: batch[k]
+            for k in (
+                "obs",
+                "next_obs",
+                "action",
+                "reward",
+                "done",
+                "terminated",
+                "behavior_logp",
+                "behavior",
+            )
+        }
+        state, metrics = self.learner.learn(state, learn_batch, lkey, axis_name)
+        n_done = batch["ep_done"].sum()
+        ep_return_sum = batch["ep_return"].sum()
+        if axis_name is not None:
+            n_done = jax.lax.psum(n_done, axis_name)
+            ep_return_sum = jax.lax.psum(ep_return_sum, axis_name)
+        metrics["episode/return"] = jnp.where(
+            n_done > 0, ep_return_sum / jnp.maximum(n_done, 1), jnp.nan
+        )
+        metrics["episode/count"] = n_done.astype(jnp.float32)
+        return state, carry, metrics
+
+    # -- main loop -----------------------------------------------------------
+    def run(
+        self,
+        max_env_steps: int | None = None,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ):
+        """Train until ``max_env_steps`` (default: session total_env_steps).
+
+        Returns (final_state, last_metrics). ``on_metrics(iteration, dict)``
+        fires every metrics.every_n_iters with host-side floats; returning
+        truthy from it stops training (used by reward-target runs).
+        """
+        cfg = self.config.session_config
+        total = max_env_steps or cfg.total_env_steps
+        steps_per_iter = self.horizon * self.num_envs
+        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
+
+        key = jax.random.key(self.seed)
+        key, init_key, env_key = jax.random.split(key, 3)
+        state = self.learner.init(init_key)
+
+        last_metrics: dict = {}
+        iteration = 0
+        env_steps = 0
+        t0 = time.time()
+
+        if self.device_mode:
+            carry = init_device_carry(self.env, env_key, self.num_envs)
+            while env_steps < total:
+                key, it_key = jax.random.split(key)
+                state, carry, metrics = self._train_iter(state, carry, it_key)
+                iteration += 1
+                env_steps += steps_per_iter
+                if metrics_every.track_increment():
+                    m = {k: float(v) for k, v in metrics.items()}
+                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
+                    m["time/env_steps"] = env_steps
+                    last_metrics = m
+                    if on_metrics and on_metrics(iteration, m):
+                        break
+        else:
+            obs = self.env.reset(seed=self.config.env_config.seed)
+            recent_returns = []
+            while env_steps < total:
+                key, r_key, l_key = jax.random.split(key, 3)
+                obs, batch, ep_stats = host_rollout(
+                    self.env, self._act, state, obs, r_key, self.horizon
+                )
+                state, metrics = self._learn(state, batch, l_key)
+                iteration += 1
+                env_steps += steps_per_iter
+                recent_returns.extend(ep_stats["returns"])
+                if metrics_every.track_increment():
+                    m = {k: float(v) for k, v in metrics.items()}
+                    if recent_returns:
+                        m["episode/return"] = float(
+                            np.mean(recent_returns[-20:])
+                        )
+                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
+                    m["time/env_steps"] = env_steps
+                    last_metrics = m
+                    if on_metrics and on_metrics(iteration, m):
+                        break
+
+        return state, last_metrics

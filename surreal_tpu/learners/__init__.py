@@ -1,0 +1,59 @@
+"""Algorithm layer (parity: reference ``surreal/learner/`` — base, ppo,
+ddpg, aggregator; SURVEY.md §2.1 — plus the IMPALA learner BASELINE
+config ⑤ requires beyond the reference)."""
+
+from surreal_tpu.envs.base import EnvSpecs
+from surreal_tpu.learners.base import (
+    EVAL_DETERMINISTIC,
+    EVAL_STOCHASTIC,
+    TRAINING,
+    Learner,
+)
+from surreal_tpu.session.config import Config
+
+
+def build_learner(learner_config, env_specs: EnvSpecs) -> Learner:
+    """Dispatch on ``algo.name`` with per-algorithm defaults extended onto
+    the user tree (parity: reference per-algo config modules in
+    ``surreal/main/*_configs.py``)."""
+    name = learner_config.algo.name
+    if name == "ppo":
+        from surreal_tpu.learners.ppo import PPO_LEARNER_CONFIG, PPOLearner
+
+        cfg = learner_config.extend(PPO_LEARNER_CONFIG.extend(_base()))
+        return PPOLearner(cfg, env_specs)
+    if name == "ddpg":
+        try:
+            from surreal_tpu.learners.ddpg import DDPG_LEARNER_CONFIG, DDPGLearner
+        except ImportError as e:
+            raise NotImplementedError("ddpg learner module not present yet") from e
+
+        cfg = learner_config.extend(DDPG_LEARNER_CONFIG.extend(_base()))
+        return DDPGLearner(cfg, env_specs)
+    if name == "impala":
+        try:
+            from surreal_tpu.learners.impala import (
+                IMPALA_LEARNER_CONFIG,
+                IMPALALearner,
+            )
+        except ImportError as e:
+            raise NotImplementedError("impala learner module not present yet") from e
+
+        cfg = learner_config.extend(IMPALA_LEARNER_CONFIG.extend(_base()))
+        return IMPALALearner(cfg, env_specs)
+    raise ValueError(f"unknown algorithm {name!r}; have ppo | ddpg | impala")
+
+
+def _base():
+    from surreal_tpu.session.default_configs import BASE_LEARNER_CONFIG
+
+    return BASE_LEARNER_CONFIG
+
+
+__all__ = [
+    "EVAL_DETERMINISTIC",
+    "EVAL_STOCHASTIC",
+    "TRAINING",
+    "Learner",
+    "build_learner",
+]

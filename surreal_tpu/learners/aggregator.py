@@ -1,0 +1,68 @@
+"""Experience aggregation (parity: reference
+``surreal/learner/aggregator.py`` — SSARAggregator and
+MultistepAggregatorWithInfo converting experience lists into torch batches,
+SURVEY.md §2.1).
+
+Here aggregation is the host↔device seam: host rollouts produce per-step
+numpy dicts; the aggregator stacks them time-major and ships ONE contiguous
+``device_put`` per batch (no per-array transfers — DCN/PCIe efficiency).
+On-device (jax-env) rollouts never touch this path; their trajectories are
+born aggregated by ``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+
+
+def stack_steps(steps: Sequence[dict]) -> dict:
+    """Stack a list of per-step dicts (possibly nested one level) into
+    time-major arrays: list of {k: [B,...]} -> {k: [T,B,...]}."""
+    out: dict = {}
+    proto = steps[0]
+    for k, v in proto.items():
+        if isinstance(v, dict):
+            out[k] = {
+                kk: np.stack([np.asarray(s[k][kk]) for s in steps]) for kk in v
+            }
+        else:
+            out[k] = np.stack([np.asarray(s[k]) for s in steps])
+    return out
+
+
+def multistep_batch(
+    steps: Sequence[dict],
+    *,
+    device_put: bool = True,
+) -> dict:
+    """PPO-style sub-trajectory batch (parity:
+    MultistepAggregatorWithInfo): time-major [T, B, ...] arrays with the
+    behavior-policy ``action_info`` carried alongside (SURVEY.md §3.2).
+
+    Each step dict must have: obs, next_obs, action, reward, done,
+    terminated, behavior_logp, behavior (dict of dist params).
+    """
+    batch = stack_steps(steps)
+    if device_put:
+        batch = jax.device_put(batch)
+    return batch
+
+
+def ssar_transitions(steps: Sequence[dict]) -> dict:
+    """DDPG-style flat (s, a, r, s', done) transitions (parity:
+    SSARAggregator): stacks steps then flattens [T, B] -> [T*B] for replay
+    insertion.
+    """
+    batch = stack_steps(steps)
+    flat = {}
+    for k, v in batch.items():
+        if isinstance(v, dict):
+            flat[k] = {
+                kk: vv.reshape(-1, *vv.shape[2:]) for kk, vv in v.items()
+            }
+        else:
+            flat[k] = v.reshape(-1, *v.shape[2:])
+    return flat

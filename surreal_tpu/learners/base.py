@@ -1,0 +1,69 @@
+"""Learner base (parity: reference ``surreal/learner/base.py`` — the main
+SGD loop owner with prefetch/publish/checkpoint hooks, SURVEY.md §2.1 and
+§3.4), re-designed functionally for XLA.
+
+The reference Learner was a stateful object with threads (batch prefetch,
+parameter publishing). Here a learner is a pair of *pure jittable
+functions* over an explicit :class:`LearnerState` pytree:
+
+    state           = learner.init(key, specs)
+    state, metrics  = learner.learn(state, batch, key)      # one SGD iter
+    action, info    = learner.act(state, obs, key, mode)    # shared params
+
+``act`` living on the same state is the TPU answer to the reference's
+ParameterPublisher→ParameterServer→ParameterClient pipeline (SURVEY.md
+§2.1 Parameter-server row): acting and learning share device memory, so
+parameter "publishing" is a no-op. Checkpointing serializes the state
+pytree (session/checkpoint.py); the driver loop lives in launch/trainer.py.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping
+
+import jax
+
+from surreal_tpu.envs.base import EnvSpecs
+
+# Agent modes (parity: reference agent modes on surreal/agent/base.py)
+TRAINING = "training"
+EVAL_DETERMINISTIC = "eval_deterministic"
+EVAL_STOCHASTIC = "eval_stochastic"
+
+
+class Learner(abc.ABC):
+    """Algorithm = init + learn + act, all pure. Subclasses hold only
+    static configuration (hyperparameters, model definitions) so their
+    methods close over nothing traced."""
+
+    def __init__(self, learner_config, env_specs: EnvSpecs):
+        self.config = learner_config
+        self.specs = env_specs
+
+    # -- state ---------------------------------------------------------------
+    @abc.abstractmethod
+    def init(self, key: jax.Array) -> Any:
+        """Build the initial LearnerState pytree (params, optimizer, aux)."""
+
+    # -- learning ------------------------------------------------------------
+    @abc.abstractmethod
+    def learn(self, state: Any, batch: Mapping[str, jax.Array], key: jax.Array):
+        """One SGD iteration. Pure; jit/shard_map-safe.
+
+        Returns (new_state, metrics dict of scalars).
+        """
+
+    # -- acting --------------------------------------------------------------
+    @abc.abstractmethod
+    def act(self, state: Any, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
+        """Batched action selection from the current state.
+
+        Returns (action, act_info) where act_info carries whatever the
+        learner needs attached to experience (behavior-policy stats — the
+        reference's ``action_info``, SURVEY.md §2.1 PPO-agent row).
+        """
+
+    # -- bookkeeping ---------------------------------------------------------
+    def default_config(self):  # override per algorithm
+        raise NotImplementedError

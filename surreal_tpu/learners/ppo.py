@@ -1,0 +1,345 @@
+"""PPO learner (parity: reference ``surreal/learner/ppo.py``, SURVEY.md
+§2.1 — GAE; clipped-surrogate AND adaptive-KL-penalty modes
+(``ppo_mode: clip|adapt``); KL early-stop and beta adaptation; lr
+annealing; grad-norm clip; ZFilter obs-normalizer update), re-designed as
+one jittable ``learn`` over time-major device arrays.
+
+TPU notes: GAE is a ``lax.scan`` (ops/returns.py); the epoch/minibatch
+loop is a nested ``lax.scan`` so the entire SGD iteration is ONE compiled
+program — no host round-trips between epochs. KL early-stop is a carried
+boolean that zeroes the policy-loss coefficient (baseline updates continue,
+matching the reference's separate policy/baseline epoch semantics without
+leaving jit).
+
+Batch layout (from launch/rollout.py or replay/fifo):
+  obs [T,B,...], next_obs [T,B,...] (pre-reset terminal obs at dones),
+  action [T,B,...], reward [T,B], done [T,B] (episode boundary),
+  terminated [T,B] (true env termination, excludes truncation),
+  behavior_logp [T,B], behavior: dist params ({mean,log_std} | {logits}).
+
+Truncation is handled exactly: bootstrap discount gamma*(1-terminated)
+pairs with V(next_obs) where next_obs is the pre-reset terminal obs, while
+the GAE accumulation decay uses gamma*lam*(1-done).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from surreal_tpu.envs.base import EnvSpecs
+from surreal_tpu.learners.base import EVAL_DETERMINISTIC, TRAINING, Learner
+from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
+from surreal_tpu.ops import distributions as D
+from surreal_tpu.ops.running_stats import (
+    RunningStats,
+    init_stats,
+    normalize,
+    update_stats,
+)
+from surreal_tpu.session.config import Config
+
+PPO_LEARNER_CONFIG = Config(
+    algo=Config(
+        name="ppo",
+        ppo_mode="clip",      # 'clip' | 'adapt'  (both reference modes)
+        lam=0.97,             # GAE lambda
+        clip_ratio=0.2,
+        kl_target=0.01,
+        kl_early_stop=4.0,    # stop policy updates when kl > factor*target
+        beta_init=1.0,        # adaptive-KL penalty coefficient
+        beta_range=(1e-3, 35.0),
+        beta_adjust=1.5,
+        horizon=128,          # rollout length per SGD iteration
+        epochs=4,
+        num_minibatches=4,
+        value_coeff=0.5,
+        entropy_coeff=0.01,
+        clip_value=True,      # PPO-style value clipping
+        norm_adv=True,
+        init_log_std=-0.5,
+    ),
+    replay=Config(kind="fifo"),
+)
+
+
+class PPOState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    obs_stats: RunningStats
+    kl_beta: jax.Array    # scalar, adaptive-KL mode
+    iteration: jax.Array  # int32
+
+
+class PPOLearner(Learner):
+    def __init__(self, learner_config, env_specs: EnvSpecs):
+        super().__init__(learner_config, env_specs)
+        algo = learner_config.algo
+        self.discrete = env_specs.discrete
+        if self.discrete:
+            self.model = CategoricalPPOModel(
+                model_cfg=learner_config.model.to_dict(),
+                n_actions=env_specs.action.n,
+            )
+        else:
+            act_dim = int(env_specs.action.shape[0])
+            self.model = PPOModel(
+                model_cfg=learner_config.model.to_dict(),
+                act_dim=act_dim,
+                init_log_std=algo.init_log_std,
+            )
+        self.tx = self._make_optimizer(learner_config.optimizer)
+
+    def _make_optimizer(self, opt_cfg) -> optax.GradientTransformation:
+        if opt_cfg.lr_schedule == "linear":
+            lr = optax.linear_schedule(
+                opt_cfg.lr, 0.0, transition_steps=opt_cfg.get("anneal_steps", 10_000)
+            )
+        else:
+            lr = opt_cfg.lr
+        return optax.chain(
+            optax.clip_by_global_norm(opt_cfg.max_grad_norm),
+            optax.adam(lr),
+        )
+
+    # -- state ---------------------------------------------------------------
+    def init(self, key: jax.Array) -> PPOState:
+        obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
+        params = self.model.init(key, obs)
+        return PPOState(
+            params=params,
+            opt_state=self.tx.init(params),
+            obs_stats=init_stats(self.specs.obs.shape)
+            if self._use_obs_filter
+            else init_stats((1,)),
+            kl_beta=jnp.asarray(self.config.algo.beta_init, jnp.float32),
+            iteration=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def _use_obs_filter(self) -> bool:
+        # pixel obs are normalized by /255 in the CNN stem, not by ZFilter
+        import numpy as np
+
+        return (
+            bool(self.config.algo.use_obs_filter)
+            and self.specs.obs.dtype != np.uint8
+        )
+
+    def _norm_obs(self, stats: RunningStats, obs: jax.Array) -> jax.Array:
+        if not self._use_obs_filter:
+            return obs
+        return normalize(stats, obs.astype(jnp.float32))
+
+    # -- acting --------------------------------------------------------------
+    def act(self, state: PPOState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
+        out = self.model.apply(
+            state.params, self._norm_obs(state.obs_stats, obs)
+        )
+        if self.discrete:
+            if mode == EVAL_DETERMINISTIC:
+                action = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+            else:
+                action = D.categorical_sample(key, out.logits).astype(jnp.int32)
+            logp = D.categorical_logp(out.logits, action)
+            info = {"logp": logp, "logits": out.logits, "value": out.value}
+        else:
+            if mode == EVAL_DETERMINISTIC:
+                action = out.mean
+            else:
+                action = D.diag_gauss_sample(key, out.mean, out.log_std)
+            logp = D.diag_gauss_logp(out.mean, out.log_std, action)
+            info = {
+                "logp": logp,
+                "mean": out.mean,
+                "log_std": out.log_std,
+                "value": out.value,
+            }
+        return action, info
+
+    # -- learning ------------------------------------------------------------
+    def learn(self, state: PPOState, batch: dict, key: jax.Array, axis_name=None):
+        """One SGD iteration. When ``axis_name`` is set (running inside
+        shard_map over a data-parallel mesh axis), gradients / obs-stats /
+        advantage normalization are psum-merged so every replica applies the
+        identical update — the TPU ICI replacement for the reference's
+        single-GPU learner + parameter server (SURVEY.md §5.8)."""
+        algo = self.config.algo
+        T, B = batch["reward"].shape
+
+        # 1) obs-normalizer update (reference: ZFilter update then broadcast)
+        if self._use_obs_filter:
+            obs_stats = update_stats(
+                state.obs_stats, batch["obs"], axis_name=axis_name
+            )
+        else:
+            obs_stats = state.obs_stats
+        obs = self._norm_obs(obs_stats, batch["obs"])
+        next_obs = self._norm_obs(obs_stats, batch["next_obs"])
+
+        # 2) GAE with exact truncation handling
+        out_t = self.model.apply(state.params, obs)
+        v_next = self.model.apply(state.params, next_obs).value
+        values = out_t.value
+        gamma = jnp.asarray(algo.gamma, jnp.float32)
+        boot_disc = gamma * (1.0 - batch["terminated"].astype(jnp.float32))
+        lam_disc_mask = 1.0 - batch["done"].astype(jnp.float32)
+        deltas_disc = boot_disc
+        # (ops.returns.gae_advantages expects a [T+1] value stack; the
+        # truncation-exact form here needs distinct bootstrap/decay masks)
+        deltas = batch["reward"] + deltas_disc * v_next - values
+        decay = gamma * algo.lam * lam_disc_mask
+
+        def gae_step(carry, xs):
+            delta_t, decay_t = xs
+            adv = delta_t + decay_t * carry
+            return adv, adv
+
+        _, advs_rev = jax.lax.scan(
+            gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1])
+        )
+        advantages = advs_rev[::-1]
+        value_targets = advantages + values
+
+        if algo.norm_adv:
+            if axis_name is None:
+                adv_mean = advantages.mean()
+                adv_var = advantages.var()
+            else:
+                adv_mean = jax.lax.pmean(advantages.mean(), axis_name)
+                adv_var = (
+                    jax.lax.pmean((advantages**2).mean(), axis_name) - adv_mean**2
+                )
+            advantages = (advantages - adv_mean) / (jnp.sqrt(adv_var) + 1e-8)
+
+        # 3) flatten time x batch -> sample axis
+        N = T * B
+        flat = {
+            "obs": obs.reshape(N, *obs.shape[2:]),
+            "action": batch["action"].reshape(N, *batch["action"].shape[2:]),
+            "behavior_logp": batch["behavior_logp"].reshape(N),
+            "adv": advantages.reshape(N),
+            "target": value_targets.reshape(N),
+            "value_old": values.reshape(N),
+        }
+        if self.discrete:
+            flat["b_logits"] = batch["behavior"]["logits"].reshape(N, -1)
+        else:
+            flat["b_mean"] = batch["behavior"]["mean"].reshape(N, -1)
+            flat["b_log_std"] = batch["behavior"]["log_std"].reshape(N, -1)
+
+        num_mb = algo.num_minibatches
+        mb_size = N // num_mb
+
+        def loss_fn(params, mb, kl_beta, policy_coeff):
+            out = self.model.apply(params, mb["obs"])
+            if self.discrete:
+                logp = D.categorical_logp(out.logits, mb["action"])
+                kl = D.categorical_kl(mb["b_logits"], out.logits).mean()
+                entropy = D.categorical_entropy(out.logits).mean()
+            else:
+                logp = D.diag_gauss_logp(out.mean, out.log_std, mb["action"])
+                kl = D.diag_gauss_kl(
+                    mb["b_mean"], mb["b_log_std"], out.mean, out.log_std
+                ).mean()
+                entropy = D.diag_gauss_entropy(out.log_std).mean()
+
+            ratio = jnp.exp(logp - mb["behavior_logp"])
+            if algo.ppo_mode == "clip":
+                clipped = jnp.clip(ratio, 1.0 - algo.clip_ratio, 1.0 + algo.clip_ratio)
+                pg_loss = -jnp.minimum(ratio * mb["adv"], clipped * mb["adv"]).mean()
+            else:  # adaptive KL penalty
+                pg_loss = -(ratio * mb["adv"]).mean() + kl_beta * kl
+
+            v = out.value
+            if algo.clip_value:
+                v_clip = mb["value_old"] + jnp.clip(
+                    v - mb["value_old"], -algo.clip_ratio, algo.clip_ratio
+                )
+                v_loss = 0.5 * jnp.maximum(
+                    (v - mb["target"]) ** 2, (v_clip - mb["target"]) ** 2
+                ).mean()
+            else:
+                v_loss = 0.5 * ((v - mb["target"]) ** 2).mean()
+
+            total = (
+                policy_coeff * (pg_loss - algo.entropy_coeff * entropy)
+                + algo.value_coeff * v_loss
+            )
+            return total, {
+                "pg_loss": pg_loss,
+                "v_loss": v_loss,
+                "entropy": entropy,
+                "kl": kl,
+            }
+
+        grad_fn = jax.grad(loss_fn, has_aux=True)
+
+        def mb_update(carry, mb_idx_perm):
+            params, opt_state, stopped = carry
+            mb = jax.tree.map(lambda x: x[mb_idx_perm], flat)
+            policy_coeff = jnp.where(stopped, 0.0, 1.0)
+            grads, aux = grad_fn(params, mb, state.kl_beta, policy_coeff)
+            if axis_name is not None:
+                grads = jax.lax.pmean(grads, axis_name)
+                aux = jax.lax.pmean(aux, axis_name)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stopped = jnp.logical_or(
+                stopped, aux["kl"] > algo.kl_early_stop * algo.kl_target
+            )
+            return (params, opt_state, stopped), aux
+
+        def epoch_update(carry, epoch_key):
+            perm = jax.random.permutation(epoch_key, N)[: num_mb * mb_size]
+            perms = perm.reshape(num_mb, mb_size)
+            carry, auxs = jax.lax.scan(mb_update, carry, perms)
+            return carry, auxs
+
+        epoch_keys = jax.random.split(key, algo.epochs)
+        (params, opt_state, stopped), auxs = jax.lax.scan(
+            epoch_update, (state.params, state.opt_state, jnp.asarray(False)), epoch_keys
+        )
+        final_kl = auxs["kl"][-1, -1]
+
+        # 4) adaptive-KL beta update (reference's beta adaptation)
+        beta = state.kl_beta
+        if algo.ppo_mode == "adapt":
+            lo, hi = algo.beta_range
+            beta = jnp.where(
+                final_kl > 2.0 * algo.kl_target,
+                jnp.minimum(beta * algo.beta_adjust, hi),
+                jnp.where(
+                    final_kl < algo.kl_target / 2.0,
+                    jnp.maximum(beta / algo.beta_adjust, lo),
+                    beta,
+                ),
+            )
+
+        new_state = PPOState(
+            params=params,
+            opt_state=opt_state,
+            obs_stats=obs_stats,
+            kl_beta=beta,
+            iteration=state.iteration + 1,
+        )
+        ev_denom = jnp.var(value_targets) + 1e-8
+        metrics: dict = {
+            "loss/pg": auxs["pg_loss"].mean(),
+            "loss/value": auxs["v_loss"].mean(),
+            "policy/entropy": auxs["entropy"].mean(),
+            "policy/kl": final_kl,
+            "policy/kl_beta": beta,
+            "policy/early_stopped": stopped.astype(jnp.float32),
+            "value/explained_variance": 1.0
+            - jnp.var(value_targets - values) / ev_denom,
+            "adv/mean_abs": jnp.abs(advantages).mean(),
+        }
+        if axis_name is not None:
+            # per-shard metrics (explained variance etc.) -> global mean so
+            # the replicated out-spec is truthful
+            metrics = jax.lax.pmean(metrics, axis_name)
+        return new_state, metrics

@@ -32,8 +32,15 @@ def init_stats(obs_shape: tuple[int, ...], dtype=jnp.float32) -> RunningStats:
     )
 
 
-def update_stats(stats: RunningStats, batch: jax.Array) -> RunningStats:
-    """Fold a batch [..., obs_dim...] into the stats (leading axes reduced)."""
+def update_stats(
+    stats: RunningStats, batch: jax.Array, axis_name: str | None = None
+) -> RunningStats:
+    """Fold a batch [..., obs_dim...] into the stats (leading axes reduced).
+
+    With ``axis_name`` (inside shard_map/pmap over a data-parallel axis)
+    the *batch* statistics are first merged across replicas, so every
+    replica folds the identical global batch and stays bitwise in sync.
+    """
     reduce_axes = tuple(range(batch.ndim - stats.mean.ndim))
     batch = batch.astype(stats.mean.dtype)
     b_count = jnp.asarray(
@@ -48,6 +55,14 @@ def update_stats(stats: RunningStats, batch: jax.Array) -> RunningStats:
         if reduce_axes
         else jnp.zeros_like(batch)
     )
+    if axis_name is not None:
+        # Chan merge of per-replica batch moments (exact, order-free)
+        n = jax.lax.psum(b_count, axis_name)
+        g_mean = jax.lax.psum(b_mean * b_count, axis_name) / n
+        b_m2 = jax.lax.psum(
+            b_m2 + b_count * (b_mean - g_mean) ** 2, axis_name
+        )
+        b_count, b_mean = n, g_mean
     delta = b_mean - stats.mean
     tot = stats.count + b_count
     new_mean = stats.mean + delta * (b_count / tot)

@@ -1,0 +1,9 @@
+"""Parallelism layer (SPMD over device meshes; SURVEY.md §2.4): mesh
+construction from topology config, data-parallel learn/train wrappers.
+The reference had no collectives (single-GPU learner + ZMQ process fleet);
+this layer is the TPU-native replacement."""
+
+from surreal_tpu.parallel.mesh import batch_sharded, make_mesh, replicated
+from surreal_tpu.parallel.dp import dp_learn, dp_train_iter
+
+__all__ = ["batch_sharded", "make_mesh", "replicated", "dp_learn", "dp_train_iter"]

@@ -1,0 +1,91 @@
+"""Data-parallel execution of learners over a device mesh (the north-star
+capability: "gradient allreduce over ICI", replacing the reference's
+single-GPU learner; SURVEY.md §2.4 DP row and §5.8).
+
+``shard_map`` over the ``dp`` axis: learner state is replicated, batches
+are sharded on their batch dim, and the learner's ``axis_name`` hook psums
+gradients / obs-stats / advantage moments so replicas stay bitwise
+identical. The same wrapper drives the fused rollout+learn step, sharding
+the env-state pytree so each device steps its own slice of envs — actors
+and learner in one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from surreal_tpu.learners.base import Learner
+
+
+def _spec_like(tree: Any, spec: P) -> Any:
+    return jax.tree.map(lambda _: spec, tree)
+
+
+def dp_learn(learner: Learner, mesh: Mesh, axis: str = "dp"):
+    """Build a jitted data-parallel ``learn``: (state, batch, key) ->
+    (state, metrics), batch sharded on dim 1 (time-major [T, B, ...])."""
+
+    def step(state, batch, key):
+        return learner.learn(state, batch, key, axis_name=axis)
+
+    def wrapped(state, batch, key):
+        shard = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                _spec_like(state, P()),
+                _spec_like(batch, P(None, axis)),
+                P(),
+            ),
+            out_specs=(_spec_like(state, P()), _spec_like_metrics(P())),
+            check_vma=False,
+        )
+        return shard(state, batch, key)
+
+    return jax.jit(wrapped)
+
+
+def _spec_like_metrics(spec: P):
+    # metrics dict structure is only known at trace time; shard_map accepts
+    # a prefix pytree — a bare spec broadcasts over the whole subtree.
+    return spec
+
+
+def dp_train_iter(trainer_iter, learner: Learner, mesh: Mesh, axis: str = "dp"):
+    """Shard a fused rollout+learn ``train_iter(state, carry, key)`` over
+    the mesh: learner state replicated, rollout carry (env states, obs,
+    episode stats) sharded on the env-batch dim.
+
+    ``trainer_iter`` must accept ``axis_name`` (kw) and thread it to
+    ``learner.learn``.
+    """
+
+    def sharded_iter(state, carry, key):
+        # decorrelate per-shard exploration noise: a replicated key would
+        # give every dp shard identical action-sampling streams
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        return trainer_iter(state, carry, key, axis_name=axis)
+
+    def wrapped(state, carry, key):
+        shard = shard_map(
+            sharded_iter,
+            mesh=mesh,
+            in_specs=(
+                _spec_like(state, P()),
+                _spec_like(carry, P(axis)),
+                P(),
+            ),
+            out_specs=(
+                _spec_like(state, P()),
+                _spec_like(carry, P(axis)),
+                P(),
+            ),
+            check_vma=False,
+        )
+        return shard(state, carry, key)
+
+    return jax.jit(wrapped)

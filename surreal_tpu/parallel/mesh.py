@@ -1,0 +1,54 @@
+"""Device-mesh construction from ``session_config.topology`` (the rebuild
+of the reference's process-group wiring, SURVEY.md §3.1: symphony assigned
+ports between OS processes; here the same config block selects mesh axes
+for ONE SPMD program).
+
+Axes:
+- ``dp`` — data parallel: env batch + learn batch sharded, grads psum'd
+  over ICI.
+- ``tp`` — tensor parallel seam (models are small MLPs today; the axis
+  exists so larger models shard without re-plumbing, SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(topology=None, devices=None) -> Mesh:
+    """Build a Mesh from a ``topology`` config subtree (or all devices).
+
+    ``topology.mesh`` maps axis name -> size, with -1 meaning "all
+    remaining devices" (at most one -1).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(topology.mesh) if topology is not None else {"dp": -1, "tp": 1}
+    names = list(axes.keys())
+    sizes = [int(axes[k]) for k in names]
+    if sizes.count(-1) > 1:
+        raise ValueError(f"topology.mesh has multiple -1 axes: {axes}")
+    fixed = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % fixed != 0:
+            raise ValueError(
+                f"device count {n} not divisible by fixed mesh axes {axes}"
+            )
+        sizes[sizes.index(-1)] = n // fixed
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp", batch_dim: int = 0) -> NamedSharding:
+    spec = [None] * (batch_dim + 1)
+    spec[batch_dim] = axis
+    return NamedSharding(mesh, P(*spec))

@@ -130,6 +130,16 @@ def _merge(base: Config, override: Mapping, path: str) -> Config:
     out = Config(copy.deepcopy(base))
     for key, value in override.items():
         full = f"{path}.{key}" if path else str(key)
+        if (
+            isinstance(value, str)
+            and value in (REQUIRED, OPTIONAL)
+            and key in out
+            and not (isinstance(out[key], str) and out[key] in (REQUIRED, OPTIONAL))
+        ):
+            # an unfilled placeholder carried in an override tree never
+            # stomps a real base value (comes up when a partially-filled
+            # bundle is re-extended onto per-algorithm defaults)
+            continue
         if key in out and isinstance(out[key], Config):
             if isinstance(value, Mapping):
                 out[key] = _merge(out[key], value, full)

@@ -42,7 +42,7 @@ BASE_LEARNER_CONFIG = Config(
         lr_schedule="constant",  # 'constant' | 'linear'
     ),
     replay=Config(
-        kind=REQUIRED,  # 'fifo' | 'uniform' | 'prioritized'
+        kind="fifo",    # 'fifo' | 'uniform' | 'prioritized' (algo defaults override)
         capacity=100_000,
         start_sample_size=1_000,
         batch_size=256,

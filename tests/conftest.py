@@ -7,7 +7,12 @@ hardware. Bench and production run on real TPU; tests are platform-CPU.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The shell exports JAX_PLATFORMS=axon (the tunneled TPU) and the axon
+# sitecustomize imports jax at interpreter boot, so jax has ALREADY latched
+# the env var by the time this conftest runs — setting os.environ here is
+# too late. jax.config.update after import is the reliable override. Tests
+# must run on local CPU with simulated devices: the tunnel pays ~120ms per
+# host<->device sync and would crawl.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,4 +21,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.devices()[0].platform == "cpu", (
+    f"tests must run on simulated CPU devices, got {jax.devices()}"
+)
+assert jax.device_count() == 8, (
+    f"expected 8 simulated devices, got {jax.device_count()} "
+    "(XLA_FLAGS was read before conftest could set it?)"
+)

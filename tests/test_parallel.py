@@ -1,0 +1,128 @@
+"""Parallel layer tests on the 8-device CPU sim mesh (SURVEY.md §4:
+"every pmap/shard_map collective path is unit-testable this way")."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+from surreal_tpu.learners import build_learner
+from surreal_tpu.parallel import dp_learn, make_mesh
+from surreal_tpu.session.config import Config
+
+
+def topo(mesh_axes):
+    return Config(mesh=Config(mesh_axes))
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(topo({"dp": -1, "tp": 1}))
+    assert mesh.shape == {"dp": 8, "tp": 1}
+    mesh = make_mesh(topo({"dp": 2, "tp": 4}))
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh(topo({"dp": 3, "tp": 1}))  # 8 % 3 != 0
+
+
+def _specs(obs_dim=6, act_dim=3):
+    return EnvSpecs(
+        obs=ArraySpec(shape=(obs_dim,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(act_dim,), dtype=np.dtype(np.float32)),
+    )
+
+
+def _batch(key, T=4, B=16, obs_dim=6, act_dim=3):
+    ks = jax.random.split(key, 4)
+    return {
+        "obs": jax.random.normal(ks[0], (T, B, obs_dim)),
+        "next_obs": jax.random.normal(ks[1], (T, B, obs_dim)),
+        "action": jax.random.normal(ks[2], (T, B, act_dim)),
+        "reward": jax.random.normal(ks[3], (T, B)),
+        "done": jnp.zeros((T, B), bool),
+        "terminated": jnp.zeros((T, B), bool),
+        "behavior_logp": jnp.full((T, B), -2.0),
+        "behavior": {
+            "mean": jnp.zeros((T, B, act_dim)),
+            "log_std": jnp.full((T, B, act_dim), -0.5),
+        },
+    }
+
+
+def test_dp_learn_matches_single_device():
+    """With one epoch and one minibatch the DP update must equal the
+    single-device update on the same global batch (grad pmean == global
+    grad mean; obs-stats Chan-merge == global fold; adv-norm pmean ==
+    global moments)."""
+    cfg = Config(
+        algo=Config(name="ppo", epochs=1, num_minibatches=1),
+    )
+    learner = build_learner(cfg, _specs())
+    state = learner.init(jax.random.key(0))
+    batch = _batch(jax.random.key(1))
+    key = jax.random.key(2)
+
+    single_state, single_metrics = jax.jit(learner.learn)(state, batch, key)
+
+    mesh = make_mesh(topo({"dp": 8}))
+    dp_step = dp_learn(learner, mesh)
+    dp_state, dp_metrics = dp_step(state, batch, key)
+
+    for path, a, b in zip(
+        jax.tree_util.tree_paths(single_state.params)
+        if hasattr(jax.tree_util, "tree_paths")
+        else [""] * len(jax.tree.leaves(single_state.params)),
+        jax.tree.leaves(single_state.params),
+        jax.tree.leaves(dp_state.params),
+    ):
+        # bf16 activations + psum-of-partial-means vs one global mean give
+        # reduction-order noise up to ~5e-4 abs; semantic equality, not
+        # bitwise, is the contract here.
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-3, err_msg=str(path)
+        )
+    np.testing.assert_allclose(
+        float(single_metrics["policy/kl"]), float(dp_metrics["policy/kl"]), atol=1e-4
+    )
+    # obs stats identical
+    np.testing.assert_allclose(
+        np.asarray(single_state.obs_stats.mean),
+        np.asarray(dp_state.obs_stats.mean),
+        rtol=1e-5,
+    )
+
+
+def test_dp_learn_multi_iteration_stays_replicated():
+    cfg = Config(algo=Config(name="ppo"))
+    learner = build_learner(cfg, _specs())
+    state = learner.init(jax.random.key(0))
+    mesh = make_mesh(topo({"dp": 8}))
+    dp_step = dp_learn(learner, mesh)
+    key = jax.random.key(1)
+    for i in range(3):
+        key, bkey, lkey = jax.random.split(key, 3)
+        state, metrics = dp_step(state, _batch(bkey), lkey)
+    assert int(state.iteration) == 3
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), k
+
+
+def test_dp_trainer_cartpole_iter_runs():
+    """Full fused rollout+learn through shard_map on the sim mesh: the
+    driver's dryrun_multichip path."""
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="ppo", horizon=8)),
+        env_config=Config(name="jax:cartpole", num_envs=16),
+        session_config=Config(
+            folder="/tmp/test_dp_trainer",
+            total_env_steps=16 * 8 * 2,  # 2 iterations
+            metrics=Config(every_n_iters=1),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    assert trainer.mesh is not None and trainer.mesh.size == 8
+    state, metrics = trainer.run()
+    assert metrics and np.isfinite(metrics["loss/value"])

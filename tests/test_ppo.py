@@ -1,0 +1,126 @@
+"""PPO learner unit tests + the CartPole does-it-learn integration test
+(SURVEY.md §4: "PPO on CartPole-v1 must reach reward >=475 within a
+time-boxed budget" — BASELINE config ①)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.envs.base import ArraySpec, DiscreteSpec, EnvSpecs
+from surreal_tpu.learners import build_learner
+from surreal_tpu.launch.trainer import Trainer
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+
+
+def _continuous_specs(obs_dim=6, act_dim=3):
+    return EnvSpecs(
+        obs=ArraySpec(shape=(obs_dim,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(act_dim,), dtype=np.dtype(np.float32)),
+    )
+
+
+def _fake_batch(key, T=8, B=4, obs_dim=6, act_dim=3):
+    ks = jax.random.split(key, 4)
+    return {
+        "obs": jax.random.normal(ks[0], (T, B, obs_dim)),
+        "next_obs": jax.random.normal(ks[1], (T, B, obs_dim)),
+        "action": jax.random.normal(ks[2], (T, B, act_dim)),
+        "reward": jax.random.normal(ks[3], (T, B)),
+        "done": jnp.zeros((T, B), bool).at[3, 1].set(True),
+        "terminated": jnp.zeros((T, B), bool).at[3, 1].set(True),
+        "behavior_logp": jnp.full((T, B), -2.0),
+        "behavior": {
+            "mean": jnp.zeros((T, B, act_dim)),
+            "log_std": jnp.full((T, B, act_dim), -0.5),
+        },
+    }
+
+
+def test_ppo_learn_updates_params_and_metrics_finite():
+    learner = build_learner(Config(algo=Config(name="ppo")), _continuous_specs())
+    state = learner.init(jax.random.key(0))
+    batch = _fake_batch(jax.random.key(1))
+    new_state, metrics = jax.jit(learner.learn)(state, batch, jax.random.key(2))
+
+    # params changed
+    diffs = jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), state.params, new_state.params
+    )
+    assert max(jax.tree.leaves(diffs)) > 0
+    assert int(new_state.iteration) == 1
+    for k, v in metrics.items():
+        assert np.isfinite(float(v)), f"metric {k} not finite"
+    # obs filter updated
+    assert float(new_state.obs_stats.count) > float(state.obs_stats.count)
+
+
+def test_ppo_adaptive_kl_mode_runs_and_adapts_beta():
+    learner = build_learner(
+        Config(algo=Config(name="ppo", ppo_mode="adapt", kl_target=1e-6)),
+        _continuous_specs(),
+    )
+    state = learner.init(jax.random.key(0))
+    batch = _fake_batch(jax.random.key(1))
+    # kl_target tiny -> any movement overshoots -> beta must increase
+    s1, m1 = jax.jit(learner.learn)(state, batch, jax.random.key(2))
+    s2, m2 = jax.jit(learner.learn)(s1, batch, jax.random.key(3))
+    assert float(s2.kl_beta) > float(state.kl_beta)
+
+
+def test_ppo_act_modes_discrete():
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(4,), dtype=np.dtype(np.float32)),
+        action=DiscreteSpec(shape=(), dtype=np.dtype(np.int32), n=2),
+    )
+    learner = build_learner(Config(algo=Config(name="ppo")), specs)
+    state = learner.init(jax.random.key(0))
+    obs = jax.random.normal(jax.random.key(1), (32, 4))
+    a, info = learner.act(state, obs, jax.random.key(2), "training")
+    assert a.shape == (32,) and a.dtype == jnp.int32
+    assert info["logp"].shape == (32,)
+    a_det, _ = learner.act(state, obs, jax.random.key(3), "eval_deterministic")
+    a_det2, _ = learner.act(state, obs, jax.random.key(4), "eval_deterministic")
+    assert bool(jnp.all(a_det == a_det2))  # deterministic ignores key
+
+
+def test_ppo_early_stop_flag_halts_policy_movement():
+    """With an absurdly low early-stop threshold the policy coefficient
+    zeroes after minibatch 1, but value learning continues."""
+    learner = build_learner(
+        Config(algo=Config(name="ppo", kl_target=1e-9, kl_early_stop=1.0)),
+        _continuous_specs(),
+    )
+    state = learner.init(jax.random.key(0))
+    batch = _fake_batch(jax.random.key(1))
+    _, metrics = jax.jit(learner.learn)(state, batch, jax.random.key(2))
+    assert float(metrics["policy/early_stopped"]) == 1.0
+
+
+@pytest.mark.slow
+def test_ppo_cartpole_reaches_475():
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", epochs=4),
+            optimizer=Config(lr=2.5e-3),
+        ),
+        env_config=Config(name="jax:cartpole", num_envs=16),
+        session_config=Config(
+            folder="/tmp/test_ppo_cartpole",
+            total_env_steps=600_000,
+            metrics=Config(every_n_iters=10),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+
+    best = {"ret": 0.0}
+
+    def cb(it, m):
+        r = m.get("episode/return", float("nan"))
+        if not np.isnan(r):
+            best["ret"] = max(best["ret"], r)
+        return best["ret"] >= 475.0  # early stop
+
+    trainer.run(on_metrics=cb)
+    assert best["ret"] >= 475.0, f"best return {best['ret']} < 475"
