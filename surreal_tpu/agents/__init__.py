@@ -2,5 +2,6 @@
 
 from surreal_tpu.agents.base import AGENT_MODES, Agent
 from surreal_tpu.agents.ppo_agent import PPOAgent
+from surreal_tpu.agents.ddpg_agent import DDPGAgent
 
-__all__ = ["AGENT_MODES", "Agent", "PPOAgent"]
+__all__ = ["AGENT_MODES", "Agent", "PPOAgent", "DDPGAgent"]

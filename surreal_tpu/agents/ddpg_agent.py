@@ -1,0 +1,17 @@
+"""DDPG agent (parity: reference ``surreal/agent/ddpg_agent.py`` —
+deterministic actor + exploration noise (OU / Gaussian) in training mode;
+SURVEY.md §2.1). Gaussian noise lives in :meth:`DDPGLearner.act`; the OU
+variant is stateful and carried by the off-policy collector
+(``launch/offpolicy_trainer.py``) via ``ou_noise_step``.
+"""
+
+from __future__ import annotations
+
+from surreal_tpu.agents.base import Agent
+from surreal_tpu.learners.base import TRAINING
+from surreal_tpu.learners.ddpg import DDPGLearner
+
+
+class DDPGAgent(Agent):
+    def __init__(self, learner: DDPGLearner, mode: str = TRAINING):
+        super().__init__(learner, mode)

@@ -1,0 +1,400 @@
+"""Off-policy training driver (DDPG-family): collect -> replay -> K SGD
+updates, the reference's actor/replay/learner triangle (SURVEY.md §3.2-3.4)
+as one program.
+
+Device mode fuses the whole iteration — H env steps (with Gaussian or
+carried-OU exploration noise), n-step folding, replay insert, and
+``updates_per_iter`` sample+learn steps (plus prioritized-priority refresh)
+— into ONE jitted function: the off-policy analogue of Trainer's fused
+on-policy iteration. Replay warmup is a ``lax.cond`` (skip updates until
+``start_sample_size``), so the compiled program is identical across the
+warmup boundary.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.envs import is_jax_env, make_env
+from surreal_tpu.envs.jax.base import batch_step
+from surreal_tpu.launch.rollout import successor_and_termination
+from surreal_tpu.learners import build_learner
+from surreal_tpu.learners.aggregator import nstep_transitions
+from surreal_tpu.learners.ddpg import ou_noise_step
+from surreal_tpu.replay import build_replay
+from surreal_tpu.session.tracker import PeriodicTracker
+
+
+class OffPolicyCarry(NamedTuple):
+    env_state: Any
+    obs: jax.Array
+    noise: jax.Array      # [B, act_dim] OU state (zeros when gaussian)
+    ep_return: jax.Array  # [B]
+    ep_length: jax.Array  # [B]
+    tail: Any             # last n_step-1 steps of the previous chunk (None if n=1)
+
+
+TRANS_KEYS = ("obs", "next_obs", "action", "reward", "done", "terminated")
+
+
+class OffPolicyTrainer:
+    def __init__(self, config):
+        self.config = config
+        self.env = make_env(config.env_config)
+        self.learner = build_learner(config.learner_config, self.env.specs)
+        algo = self.learner.config.algo
+        self.algo = algo
+        self.replay = build_replay(self.learner.config.replay)
+        self.horizon = algo.horizon
+        self.num_envs = config.env_config.num_envs
+        self.device_mode = is_jax_env(self.env)
+        self.seed = config.session_config.seed
+        self.prioritized = self.learner.config.replay.kind == "prioritized"
+        if self.device_mode:
+            self._train_iter = jax.jit(self._device_train_iter)
+        else:
+            self._act = jax.jit(self.learner.act, static_argnames="mode")
+            self._learn = jax.jit(self.learner.learn)
+            self._insert = jax.jit(self.replay.insert)
+            self._sample = jax.jit(self.replay.sample)
+            self._nstep = jax.jit(
+                lambda traj: nstep_transitions(traj, algo.gamma, algo.n_step)
+            )
+            if self.prioritized:
+                self._update_prio = jax.jit(self.replay.update_priorities)
+
+    # -- device (fused) path -------------------------------------------------
+    def _rollout(self, state, carry: OffPolicyCarry, key: jax.Array, warmup):
+        explo = self.algo.exploration
+
+        def step(c: OffPolicyCarry, step_key):
+            akey, nkey, wkey = jax.random.split(step_key, 3)
+            if explo.noise == "ou":
+                a_det, _ = self.learner.act(state, c.obs, akey, "eval_deterministic")
+                noise = ou_noise_step(
+                    c.noise, nkey, explo.ou_theta, explo.sigma, explo.ou_dt
+                )
+                action = jnp.clip(a_det + noise, -1.0, 1.0)
+            else:
+                action, _ = self.learner.act(state, c.obs, akey, "training")
+                noise = c.noise
+            # exploration warmup: uniform-random actions until the replay
+            # holds enough diverse data (classic off-policy bootstrap fix)
+            random_action = jax.random.uniform(
+                wkey, action.shape, action.dtype, -1.0, 1.0
+            )
+            action = jnp.where(warmup, random_action, action)
+            env_state, obs2, reward, done, info = batch_step(self.env, c.env_state, action)
+            next_obs, terminated = successor_and_termination(obs2, done, info)
+            done_b = done.reshape(done.shape + (1,) * (obs2.ndim - done.ndim))
+            ep_return = c.ep_return + reward
+            ep_length = c.ep_length + 1
+            trans = {
+                "obs": c.obs,
+                "next_obs": next_obs,
+                "action": action,
+                "reward": reward,
+                "done": done,
+                "terminated": terminated,
+                "ep_return": jnp.where(done, ep_return, 0.0),
+                "ep_done": done,
+            }
+            new_c = c._replace(
+                env_state=env_state,
+                obs=obs2,
+                # reset OU state at episode boundaries
+                noise=jnp.where(done_b, 0.0, noise),
+                ep_return=jnp.where(done, 0.0, ep_return),
+                ep_length=jnp.where(done, 0, ep_length),
+            )
+            return new_c, trans
+
+        keys = jax.random.split(key, self.horizon)
+        return jax.lax.scan(step, carry, keys)
+
+    def _device_train_iter(self, state, replay_state, carry, key, beta, warmup):
+        rkey, ukey = jax.random.split(key)
+        carry, traj = self._rollout(state, carry, rkey, warmup)
+        chunk = {k: traj[k] for k in TRANS_KEYS}
+        n = self.algo.n_step
+        if n > 1:
+            # prepend the previous chunk's tail so the n-1 steps at every
+            # chunk boundary still become window STARTS (without this they
+            # would silently never enter replay); carry the new tail on.
+            full = jax.tree.map(
+                lambda a, b: jnp.concatenate([a, b], axis=0), carry.tail, chunk
+            )
+            carry = carry._replace(
+                tail=jax.tree.map(lambda x: x[-(n - 1):], full)
+            )
+        else:
+            full = chunk
+        trans = nstep_transitions(full, self.algo.gamma, n)
+        replay_state = self.replay.insert(replay_state, trans)
+        # obs-normalizer: fold each fresh obs exactly once per chunk
+        state = self.learner.update_obs_stats(state, chunk["obs"])
+
+        def run_updates(operand):
+            state, replay_state = operand
+
+            def one_update(c, update_key):
+                state, replay_state = c
+                if self.prioritized:
+                    replay_state, batch, info = self.replay.sample(
+                        replay_state, update_key, beta=beta
+                    )
+                    batch = dict(batch, is_weights=info["is_weights"])
+                else:
+                    replay_state, batch, info = self.replay.sample(
+                        replay_state, update_key
+                    )
+                state, metrics = self.learner.learn(state, batch, update_key)
+                td_abs = metrics.pop("priority/td_abs")
+                if self.prioritized:
+                    replay_state = self.replay.update_priorities(
+                        replay_state, info["idx"], td_abs
+                    )
+                return (state, replay_state), metrics
+
+            (state, replay_state), metrics = jax.lax.scan(
+                one_update,
+                (state, replay_state),
+                jax.random.split(ukey, self.algo.updates_per_iter),
+            )
+            return state, replay_state, jax.tree.map(jnp.mean, metrics)
+
+        def skip_updates(operand):
+            state, replay_state = operand
+            zero_metrics = {
+                "loss/critic": jnp.zeros(()),
+                "loss/actor": jnp.zeros(()),
+                "q/mean_target": jnp.zeros(()),
+                "q/mean_abs_td": jnp.zeros(()),
+            }
+            return state, replay_state, zero_metrics
+
+        state, replay_state, metrics = jax.lax.cond(
+            self.replay.can_sample(replay_state),
+            run_updates,
+            skip_updates,
+            (state, replay_state),
+        )
+        n_done = traj["ep_done"].sum()
+        metrics["episode/return"] = jnp.where(
+            n_done > 0, traj["ep_return"].sum() / jnp.maximum(n_done, 1), jnp.nan
+        )
+        metrics["episode/count"] = n_done.astype(jnp.float32)
+        return state, replay_state, carry, metrics
+
+    # -- main loop -----------------------------------------------------------
+    def run(
+        self,
+        max_env_steps: int | None = None,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ):
+        cfg = self.config.session_config
+        total = max_env_steps or cfg.total_env_steps
+        steps_per_iter = self.horizon * self.num_envs
+        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
+        act_dim = int(self.env.specs.action.shape[0])
+
+        key = jax.random.key(self.seed)
+        key, init_key, env_key = jax.random.split(key, 3)
+        state = self.learner.init(init_key)
+
+        iteration = 0
+        env_steps = 0
+        last_metrics: dict = {}
+        t0 = time.time()
+
+        if self.device_mode:
+            keys = jax.random.split(env_key, self.num_envs)
+            env_state, obs = jax.vmap(self.env.reset)(keys)
+            n = self.algo.n_step
+            if n > 1:
+                B = self.num_envs
+                obs_shape = self.env.specs.obs.shape
+                tail = {
+                    "obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
+                    "next_obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
+                    "action": jnp.zeros((n - 1, B, act_dim), jnp.float32),
+                    "reward": jnp.zeros((n - 1, B), jnp.float32),
+                    # done=True + terminated=True: windows starting in the
+                    # fake prefix die at once with reward 0 and discount 0
+                    "done": jnp.ones((n - 1, B), bool),
+                    "terminated": jnp.ones((n - 1, B), bool),
+                }
+            else:
+                tail = None
+            carry = OffPolicyCarry(
+                env_state=env_state,
+                obs=obs,
+                noise=jnp.zeros((self.num_envs, act_dim), jnp.float32),
+                ep_return=jnp.zeros(self.num_envs, jnp.float32),
+                ep_length=jnp.zeros(self.num_envs, jnp.int32),
+                tail=tail,
+            )
+            example = jax.tree.map(
+                lambda x: jnp.zeros(x.shape[2:], x.dtype),
+                {
+                    "obs": jnp.zeros((1, 1, *self.env.specs.obs.shape), jnp.float32),
+                    "next_obs": jnp.zeros((1, 1, *self.env.specs.obs.shape), jnp.float32),
+                    "action": jnp.zeros((1, 1, act_dim), jnp.float32),
+                    "reward": jnp.zeros((1, 1), jnp.float32),
+                    "discount": jnp.zeros((1, 1), jnp.float32),
+                },
+            )
+            replay_state = self.replay.init(example)
+            while env_steps < total:
+                key, it_key = jax.random.split(key)
+                beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
+                warmup = jnp.asarray(
+                    env_steps < self.algo.exploration.warmup_steps
+                )
+                state, replay_state, carry, metrics = self._train_iter(
+                    state, replay_state, carry, it_key, beta, warmup
+                )
+                iteration += 1
+                env_steps += steps_per_iter
+                if metrics_every.track_increment():
+                    m = {k: float(v) for k, v in metrics.items()}
+                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
+                    m["time/env_steps"] = env_steps
+                    last_metrics = m
+                    if on_metrics and on_metrics(iteration, m):
+                        break
+        else:
+            state, last_metrics = self._run_host(total, on_metrics, t0)
+
+        return state, last_metrics
+
+    def _beta(self, env_steps: int, total: int) -> float:
+        """Prioritized IS beta anneal beta0 -> 1.0 over training."""
+        if not self.prioritized:
+            return 0.0
+        frac = min(env_steps / max(total, 1), 1.0)
+        b0 = self.learner.config.replay.priority_beta0
+        return b0 + (1.0 - b0) * frac
+
+    # -- host path -----------------------------------------------------------
+    def _run_host(self, total, on_metrics, t0):
+        cfg = self.config.session_config
+        steps_per_iter = self.horizon * self.num_envs
+        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
+        act_dim = int(self.env.specs.action.shape[0])
+
+        key = jax.random.key(self.seed + 1)
+        key, init_key = jax.random.split(key)
+        state = self.learner.init(init_key)
+        obs = self.env.reset(seed=self.config.env_config.seed)
+        example = {
+            "obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
+            "next_obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
+            "action": jnp.zeros((act_dim,), jnp.float32),
+            "reward": jnp.zeros((), jnp.float32),
+            "discount": jnp.zeros((), jnp.float32),
+        }
+        replay_state = self.replay.init(example)
+        noise = np.zeros((self.num_envs, act_dim), np.float32)
+        explo = self.algo.exploration
+        n = self.algo.n_step
+        if n > 1:
+            B = self.num_envs
+            obs_shape = self.env.specs.obs.shape
+            host_tail = {
+                "obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
+                "next_obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
+                "action": jnp.zeros((n - 1, B, act_dim), jnp.float32),
+                "reward": jnp.zeros((n - 1, B), jnp.float32),
+                "done": jnp.ones((n - 1, B), bool),
+                "terminated": jnp.ones((n - 1, B), bool),
+            }
+        else:
+            host_tail = None
+
+        env_steps = 0
+        iteration = 0
+        last_metrics: dict = {}
+        recent_returns: list = []
+        while env_steps < total:
+            steps = []
+            warmup = env_steps < explo.warmup_steps
+            for _ in range(self.horizon):
+                key, akey, nkey = jax.random.split(key, 3)
+                if warmup:
+                    action = np.random.default_rng(
+                        int(jax.random.randint(akey, (), 0, 2**31 - 1))
+                    ).uniform(-1.0, 1.0, (self.num_envs, act_dim)).astype(np.float32)
+                elif explo.noise == "ou":
+                    a_det, _ = self._act(state, jnp.asarray(obs), akey, mode="eval_deterministic")
+                    noise = np.asarray(
+                        ou_noise_step(jnp.asarray(noise), nkey, explo.ou_theta, explo.sigma, explo.ou_dt)
+                    )
+                    action = np.clip(np.asarray(a_det) + noise, -1.0, 1.0)
+                else:
+                    a, _ = self._act(state, jnp.asarray(obs), akey, mode="training")
+                    action = np.asarray(a)
+                out = self.env.step(action)
+                term_obs = out.info.get("terminal_obs", out.obs)
+                done_b = out.done.reshape(out.done.shape + (1,) * (out.obs.ndim - 1))
+                truncated = np.asarray(out.info.get("truncated", np.zeros(len(out.done), bool)))
+                steps.append(
+                    {
+                        "obs": obs,
+                        "next_obs": np.where(done_b, term_obs, out.obs),
+                        "action": action,
+                        "reward": out.reward,
+                        "done": out.done,
+                        "terminated": out.done & ~truncated,
+                    }
+                )
+                if out.done.any():
+                    noise[out.done] = 0.0
+                if "episode_returns" in out.info:
+                    recent_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
+                obs = out.obs
+            traj = {k: jnp.asarray(np.stack([s[k] for s in steps])) for k in steps[0]}
+            if host_tail is not None:
+                full = jax.tree.map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0), host_tail, traj
+                )
+                host_tail = jax.tree.map(
+                    lambda x: x[-(self.algo.n_step - 1):], full
+                )
+            else:
+                full = traj
+            trans = self._nstep(full)
+            replay_state = self._insert(replay_state, trans)
+            state = self.learner.update_obs_stats(state, traj["obs"])
+            if bool(self.replay.can_sample(replay_state)):
+                beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
+                for _ in range(self.algo.updates_per_iter):
+                    key, skey = jax.random.split(key)
+                    if self.prioritized:
+                        replay_state, batch, info = self._sample(replay_state, skey, beta=beta)
+                        batch = dict(batch, is_weights=info["is_weights"])
+                    else:
+                        replay_state, batch, info = self._sample(replay_state, skey)
+                    state, metrics = self._learn(state, batch, skey)
+                    td_abs = metrics.pop("priority/td_abs")
+                    if self.prioritized:
+                        replay_state = self._update_prio(replay_state, info["idx"], td_abs)
+            else:
+                metrics = {}
+            iteration += 1
+            env_steps += steps_per_iter
+            if metrics_every.track_increment():
+                m = {k: float(v) for k, v in metrics.items()}
+                if recent_returns:
+                    m["episode/return"] = float(np.mean(recent_returns[-20:]))
+                m["time/env_steps"] = env_steps
+                m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
+                last_metrics = m
+                if on_metrics and on_metrics(iteration, m):
+                    break
+        return state, last_metrics

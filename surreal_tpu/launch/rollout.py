@@ -38,6 +38,25 @@ class RolloutCarry(NamedTuple):
     ep_length: jax.Array  # [B] running episode length
 
 
+def successor_and_termination(obs2, done, step_info):
+    """The two auto-reset invariants every collector must share:
+
+    - the true successor obs at a done step is the PRE-reset terminal obs
+      (``obs2`` is already the next episode's reset obs);
+    - ``terminated`` is a genuine env termination — done minus truncation —
+      which is what zeroes bootstrap targets.
+
+    Centralised so device and host, on- and off-policy collectors cannot
+    drift (these are the classic silent-bias spots, SURVEY.md §7).
+    """
+    terminal_obs = step_info["terminal_obs"]
+    truncated = step_info["truncated"]
+    done_b = done.reshape(done.shape + (1,) * (obs2.ndim - done.ndim))
+    next_obs = jnp.where(done_b, terminal_obs, obs2)
+    terminated = jnp.logical_and(done, jnp.logical_not(truncated))
+    return next_obs, terminated
+
+
 def device_rollout(
     env: AutoReset,
     learner: Learner,
@@ -60,11 +79,7 @@ def device_rollout(
         env_state, obs2, reward, done, step_info = batch_step(
             env, c.env_state, action
         )
-        terminal_obs = step_info["terminal_obs"]
-        truncated = step_info["truncated"]
-        # obs2 is post-reset at dones; the true successor is terminal_obs
-        done_b = done.reshape(done.shape + (1,) * (obs2.ndim - done.ndim))
-        next_obs = jnp.where(done_b, terminal_obs, obs2)
+        next_obs, terminated = successor_and_termination(obs2, done, step_info)
         ep_return = c.ep_return + reward
         ep_length = c.ep_length + 1
         trans = {
@@ -73,7 +88,7 @@ def device_rollout(
             "action": action,
             "reward": reward,
             "done": done,
-            "terminated": jnp.logical_and(done, jnp.logical_not(truncated)),
+            "terminated": terminated,
             "behavior_logp": info["logp"],
             "behavior": {
                 k: v for k, v in info.items() if k in ("mean", "log_std", "logits")

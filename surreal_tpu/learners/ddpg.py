@@ -1,0 +1,260 @@
+"""DDPG learner (parity: reference ``surreal/learner/ddpg.py``, SURVEY.md
+§2.1 — critic TD loss with n-step returns, actor DPG loss, target networks
+with soft-tau AND periodic-hard update modes; exploration noise per
+``surreal/agent/ddpg_agent.py``).
+
+Functional TPU design: one :class:`DDPGState` pytree carries live+target
+params and both optimizers; ``learn`` consumes flat n-step transitions
+(built by ``aggregator.nstep_transitions`` from time-major rollouts, the
+reference aggregator's n-step helper relocated on-device) and optionally
+IS weights from prioritized replay (BASELINE config ③), returning
+per-sample |TD| for priority refresh. Everything jits; ``axis_name``
+enables dp gradient pmean exactly as in the PPO learner.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from surreal_tpu.envs.base import EnvSpecs
+from surreal_tpu.learners.base import TRAINING, Learner
+from surreal_tpu.models.ddpg_net import DDPGActor, DDPGCritic
+from surreal_tpu.ops.running_stats import (
+    RunningStats,
+    init_stats,
+    normalize,
+    update_stats,
+)
+from surreal_tpu.session.config import Config
+
+DDPG_LEARNER_CONFIG = Config(
+    algo=Config(
+        name="ddpg",
+        n_step=1,             # >1 enables the aggregator's n-step folding
+        actor_lr=1e-3,
+        critic_lr=1e-3,
+        target=Config(
+            mode="soft",       # 'soft' (tau each step) | 'hard' (copy every N)
+            tau=0.005,
+            hard_every=500,
+        ),
+        exploration=Config(
+            noise="ou",        # 'ou' | 'gaussian' (OU state lives in the rollout carry)
+            sigma=0.2,
+            ou_theta=0.15,
+            ou_dt=1.0,
+            warmup_steps=2000,  # uniform-random actions before policy acting
+        ),
+        updates_per_iter=64,   # SGD updates per collect chunk (off-policy ratio)
+        horizon=16,            # collect chunk length per iteration
+        use_layer_norm=True,
+    ),
+    replay=Config(kind="uniform"),
+)
+
+
+class DDPGState(NamedTuple):
+    actor_params: dict
+    critic_params: dict
+    target_actor_params: dict
+    target_critic_params: dict
+    actor_opt: optax.OptState
+    critic_opt: optax.OptState
+    obs_stats: RunningStats
+    iteration: jax.Array  # int32 learn-call counter (drives hard updates)
+
+
+class DDPGLearner(Learner):
+    def __init__(self, learner_config, env_specs: EnvSpecs):
+        super().__init__(learner_config, env_specs)
+        if env_specs.discrete:
+            raise ValueError("DDPG requires a continuous action space")
+        self.act_dim = int(env_specs.action.shape[0])
+        model_cfg = learner_config.model.to_dict()
+        self.actor = DDPGActor(model_cfg=model_cfg, act_dim=self.act_dim)
+        self.critic = DDPGCritic(
+            model_cfg=model_cfg, use_layer_norm=learner_config.algo.use_layer_norm
+        )
+        self.actor_tx = optax.chain(
+            optax.clip_by_global_norm(learner_config.optimizer.max_grad_norm),
+            optax.adam(learner_config.algo.actor_lr),
+        )
+        self.critic_tx = optax.chain(
+            optax.clip_by_global_norm(learner_config.optimizer.max_grad_norm),
+            optax.adam(learner_config.algo.critic_lr),
+        )
+
+    # -- state ---------------------------------------------------------------
+    def init(self, key: jax.Array) -> DDPGState:
+        ka, kc = jax.random.split(key)
+        obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
+        act = jnp.zeros((1, self.act_dim), jnp.float32)
+        actor_params = self.actor.init(ka, obs)
+        critic_params = self.critic.init(kc, obs, act)
+        return DDPGState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_actor_params=jax.tree.map(jnp.copy, actor_params),
+            target_critic_params=jax.tree.map(jnp.copy, critic_params),
+            actor_opt=self.actor_tx.init(actor_params),
+            critic_opt=self.critic_tx.init(critic_params),
+            obs_stats=init_stats(self.specs.obs.shape)
+            if self._use_obs_filter
+            else init_stats((1,)),
+            iteration=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def _use_obs_filter(self) -> bool:
+        return (
+            bool(self.config.algo.use_obs_filter)
+            and self.specs.obs.dtype != np.uint8
+        )
+
+    def _norm_obs(self, stats: RunningStats, obs: jax.Array) -> jax.Array:
+        if not self._use_obs_filter:
+            return obs
+        return normalize(stats, obs.astype(jnp.float32))
+
+    # -- acting --------------------------------------------------------------
+    def act(self, state: DDPGState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
+        """Deterministic actor; training mode adds Gaussian exploration
+        noise (OU noise is stateful — the off-policy collector carries it
+        via :func:`ou_noise_step` and adds it outside)."""
+        a = self.actor.apply(
+            state.actor_params, self._norm_obs(state.obs_stats, obs)
+        )
+        if mode == TRAINING and self.config.algo.exploration.noise == "gaussian":
+            a = a + self.config.algo.exploration.sigma * jax.random.normal(
+                key, a.shape, a.dtype
+            )
+        return jnp.clip(a, -1.0, 1.0), {}
+
+    def update_obs_stats(
+        self, state: DDPGState, fresh_obs: jax.Array, axis_name=None
+    ) -> DDPGState:
+        """Fold FRESH trajectory obs into the normalizer, once per collect
+        chunk (the reference ZFilter semantics). Deliberately NOT done in
+        ``learn``: replayed minibatches resample transitions many times and
+        under prioritized replay are biased toward high-|TD| states, which
+        would skew and over-count the running stats."""
+        if not self._use_obs_filter:
+            return state
+        return state._replace(
+            obs_stats=update_stats(state.obs_stats, fresh_obs, axis_name=axis_name)
+        )
+
+    # -- learning ------------------------------------------------------------
+    def learn(self, state: DDPGState, batch: dict, key: jax.Array, axis_name=None):
+        """One SGD update on flat n-step transitions.
+
+        batch: obs [B,...], action [B,A], reward [B] (n-step sum),
+        next_obs [B,...] (s_{t+n}), discount [B] (gamma^k * not-terminated,
+        0 past episode end), optional is_weights [B]. Obs-normalizer stats
+        are read-only here; see :meth:`update_obs_stats`.
+        """
+        del key
+        algo = self.config.algo
+        obs_stats = state.obs_stats
+        obs = self._norm_obs(obs_stats, batch["obs"])
+        next_obs = self._norm_obs(obs_stats, batch["next_obs"])
+        is_w = batch.get("is_weights")
+        if is_w is None:
+            is_w = jnp.ones_like(batch["reward"])
+
+        # critic: TD target from target networks
+        next_a = self.actor.apply(state.target_actor_params, next_obs)
+        q_next = self.critic.apply(state.target_critic_params, next_obs, next_a)
+        target = batch["reward"] + batch["discount"] * q_next
+        target = jax.lax.stop_gradient(target)
+
+        def critic_loss_fn(critic_params):
+            q = self.critic.apply(critic_params, obs, batch["action"])
+            td = q - target
+            return (is_w * td**2).mean(), td
+
+        (c_loss, td), c_grads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
+            state.critic_params
+        )
+
+        # actor: deterministic policy gradient through the live critic
+        def actor_loss_fn(actor_params):
+            a = self.actor.apply(actor_params, obs)
+            return -(is_w * self.critic.apply(state.critic_params, obs, a)).mean()
+
+        a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+
+        if axis_name is not None:
+            c_grads = jax.lax.pmean(c_grads, axis_name)
+            a_grads = jax.lax.pmean(a_grads, axis_name)
+
+        c_updates, critic_opt = self.critic_tx.update(
+            c_grads, state.critic_opt, state.critic_params
+        )
+        critic_params = optax.apply_updates(state.critic_params, c_updates)
+        a_updates, actor_opt = self.actor_tx.update(
+            a_grads, state.actor_opt, state.actor_params
+        )
+        actor_params = optax.apply_updates(state.actor_params, a_updates)
+
+        # target update: soft every step, or hard copy every N
+        iteration = state.iteration + 1
+        if algo.target.mode == "soft":
+            tau = algo.target.tau
+            target_actor = optax.incremental_update(
+                actor_params, state.target_actor_params, tau
+            )
+            target_critic = optax.incremental_update(
+                critic_params, state.target_critic_params, tau
+            )
+        else:
+            do_copy = (iteration % algo.target.hard_every) == 0
+
+            def pick(new, old):
+                return jax.tree.map(
+                    lambda n, o: jnp.where(do_copy, n, o), new, old
+                )
+
+            target_actor = pick(actor_params, state.target_actor_params)
+            target_critic = pick(critic_params, state.target_critic_params)
+
+        new_state = DDPGState(
+            actor_params=actor_params,
+            critic_params=critic_params,
+            target_actor_params=target_actor,
+            target_critic_params=target_critic,
+            actor_opt=actor_opt,
+            critic_opt=critic_opt,
+            obs_stats=obs_stats,
+            iteration=iteration,
+        )
+        metrics = {
+            "loss/critic": c_loss,
+            "loss/actor": a_loss,
+            "q/mean_target": target.mean(),
+            "q/mean_abs_td": jnp.abs(td).mean(),
+        }
+        if axis_name is not None:
+            metrics = jax.lax.pmean(metrics, axis_name)
+        # per-sample |TD| rides along for prioritized-replay refresh; the
+        # off-policy trainer pops it before treating metrics as scalars
+        metrics["priority/td_abs"] = jnp.abs(td)
+        return new_state, metrics
+
+    def default_config(self):
+        return DDPG_LEARNER_CONFIG
+
+
+def ou_noise_step(
+    noise: jax.Array, key: jax.Array, theta: float, sigma: float, dt: float = 1.0
+) -> jax.Array:
+    """One Ornstein-Uhlenbeck step (parity: the reference DDPG agent's OU
+    exploration). Carried by the collector: noise [B, act_dim]."""
+    drift = -theta * noise * dt
+    diffusion = sigma * jnp.sqrt(dt) * jax.random.normal(key, noise.shape, noise.dtype)
+    return noise + drift + diffusion

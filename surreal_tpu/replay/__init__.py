@@ -1,0 +1,24 @@
+"""Replay / data plane (parity: reference ``surreal/replay/`` — base,
+uniform, FIFO, sharded+LB; SURVEY.md §2.1 — plus prioritized replay which
+BASELINE config ③ requires beyond the reference)."""
+
+from surreal_tpu.replay.base import RingState, can_sample, init_ring, ring_gather, ring_insert
+from surreal_tpu.replay.fifo import FIFOReplay, FIFOState
+from surreal_tpu.replay.prioritized import PrioritizedReplay, PrioritizedState
+from surreal_tpu.replay.sharded import build_replay, shard_replay_state
+from surreal_tpu.replay.uniform import UniformReplay
+
+__all__ = [
+    "RingState",
+    "can_sample",
+    "init_ring",
+    "ring_gather",
+    "ring_insert",
+    "FIFOReplay",
+    "FIFOState",
+    "PrioritizedReplay",
+    "PrioritizedState",
+    "UniformReplay",
+    "build_replay",
+    "shard_replay_state",
+]

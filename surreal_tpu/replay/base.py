@@ -1,0 +1,73 @@
+"""Replay layer core (parity: reference ``surreal/replay/base.py`` —
+collector/sampler service threads over ZMQ, SURVEY.md §2.1 and §3.3),
+re-designed as HBM-resident ring buffers.
+
+The reference ran replay as a separate process: a collector thread pulled
+experience off ZMQ and ``insert()``-ed, a sampler thread served batches on
+request, ``start_sample_condition`` gated early sampling, eviction was
+FIFO. Here the buffer IS a device pytree and insert/sample are pure
+jittable functions — the "service" threads disappear into the training
+program's dataflow; under a dp mesh each device owns a shard of the buffer
+(the reference's ShardedReplay, for free, see replay/sharded.py).
+
+All buffers store flat transition dicts: {k: [capacity, ...]} with a write
+cursor and size. Insertion is vectorized (a whole [N, ...] batch lands in
+one ``dynamic_update_slice``-style scatter).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RingState(NamedTuple):
+    """Shared ring-buffer bookkeeping."""
+
+    storage: Any       # {k: [capacity, ...]} pytree
+    cursor: jax.Array  # int32 next write position
+    size: jax.Array    # int32 current fill
+
+
+def init_ring(example: Any, capacity: int) -> RingState:
+    """Allocate storage from one example transition pytree {k: [...]}
+    (leading batch dims stripped by the caller)."""
+    storage = jax.tree.map(
+        lambda x: jnp.zeros((capacity, *jnp.shape(x)), jnp.asarray(x).dtype), example
+    )
+    return RingState(
+        storage=storage,
+        cursor=jnp.zeros((), jnp.int32),
+        size=jnp.zeros((), jnp.int32),
+    )
+
+
+def ring_insert(state: RingState, batch: Any, capacity: int) -> RingState:
+    """Insert a [N, ...] batch at the cursor with wraparound (FIFO evict).
+
+    N is a static shape; positions are ``(cursor + arange(N)) % capacity``
+    — one scatter per leaf, fully on device.
+    """
+    n = jax.tree.leaves(batch)[0].shape[0]
+    idx = (state.cursor + jnp.arange(n, dtype=jnp.int32)) % capacity
+    storage = jax.tree.map(
+        lambda buf, new: buf.at[idx].set(new.astype(buf.dtype)), state.storage, batch
+    )
+    return RingState(
+        storage=storage,
+        cursor=(state.cursor + n) % capacity,
+        size=jnp.minimum(state.size + n, capacity),
+    )
+
+
+def ring_gather(state: RingState, idx: jax.Array) -> Any:
+    """Gather transitions at ``idx`` -> {k: [B, ...]}."""
+    return jax.tree.map(lambda buf: buf[idx], state.storage)
+
+
+def can_sample(size: jax.Array, start_sample_size: int) -> jax.Array:
+    """The reference's ``start_sample_condition`` (min fill before the
+    learner may draw)."""
+    return size >= start_sample_size

@@ -1,0 +1,69 @@
+"""FIFO on-policy queue (parity: reference FIFO replay for PPO — freshest
+trajectories, dequeue-on-sample; SURVEY.md §2.1).
+
+Stores whole time-major trajectory batches [T, B, ...] as queue slots (the
+reference queued sub-trajectory windows the same way). The fused trainer
+bypasses this (rollouts feed ``learn`` directly); the FIFO exists for the
+async SEED serving path where collection and learning are decoupled, and
+for capability parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class FIFOState(NamedTuple):
+    storage: Any        # {k: [slots, T, B, ...]}
+    head: jax.Array     # int32 oldest slot
+    size: jax.Array     # int32 filled slots
+
+
+class FIFOReplay:
+    def __init__(self, replay_config):
+        # 'capacity' counts queued trajectory batches here (slots)
+        self.slots = int(replay_config.get("slots", 8))
+
+    def init(self, example_traj: Any) -> FIFOState:
+        storage = jax.tree.map(
+            lambda x: jnp.zeros((self.slots, *jnp.shape(x)), jnp.asarray(x).dtype),
+            example_traj,
+        )
+        return FIFOState(
+            storage=storage,
+            head=jnp.zeros((), jnp.int32),
+            size=jnp.zeros((), jnp.int32),
+        )
+
+    def insert(self, state: FIFOState, traj: Any) -> FIFOState:
+        """Enqueue one trajectory batch; if full, overwrite the oldest
+        (on-policy data ages out — freshest wins, as in the reference)."""
+        tail = (state.head + state.size) % self.slots
+        storage = jax.tree.map(
+            lambda buf, new: buf.at[tail].set(new.astype(buf.dtype)),
+            state.storage,
+            traj,
+        )
+        full = state.size >= self.slots
+        return FIFOState(
+            storage=storage,
+            head=jnp.where(full, (state.head + 1) % self.slots, state.head),
+            size=jnp.where(full, state.size, state.size + 1),
+        )
+
+    def can_sample(self, state: FIFOState) -> jax.Array:
+        return state.size > 0
+
+    def sample(self, state: FIFOState, key: jax.Array = None):
+        """Dequeue the oldest trajectory batch -> (state, traj)."""
+        del key
+        traj = jax.tree.map(lambda buf: buf[state.head], state.storage)
+        new = FIFOState(
+            storage=state.storage,
+            head=(state.head + 1) % self.slots,
+            size=jnp.maximum(state.size - 1, 0),
+        )
+        return new, traj

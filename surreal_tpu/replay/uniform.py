@@ -1,0 +1,37 @@
+"""Uniform replay (parity: reference ``surreal/replay/uniform_replay.py``
+— ring buffer + uniform sampling, the DDPG path; SURVEY.md §2.1)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.replay.base import RingState, can_sample, init_ring, ring_gather, ring_insert
+
+
+class UniformReplay:
+    """Pure-function uniform replay over a device ring buffer."""
+
+    def __init__(self, replay_config):
+        self.capacity = int(replay_config.capacity)
+        self.batch_size = int(replay_config.batch_size)
+        self.start_sample_size = int(replay_config.start_sample_size)
+
+    def init(self, example_transition: Any) -> RingState:
+        return init_ring(example_transition, self.capacity)
+
+    def insert(self, state: RingState, batch: Any) -> RingState:
+        return ring_insert(state, batch, self.capacity)
+
+    def can_sample(self, state: RingState) -> jax.Array:
+        return can_sample(state.size, self.start_sample_size)
+
+    def sample(self, state: RingState, key: jax.Array, batch_size: int | None = None):
+        """-> (state, batch, info). Uniform with replacement over the
+        current fill; size is traced, so indices are ``randint % size``."""
+        bs = batch_size or self.batch_size
+        idx = jax.random.randint(key, (bs,), 0, jnp.maximum(state.size, 1))
+        batch = ring_gather(state, idx)
+        return state, batch, {"idx": idx}
