@@ -1,0 +1,32 @@
+"""Distributed layer (parity: reference ``surreal/distributed/`` — param
+server stack, experience senders, ModuleDict; SURVEY.md §2.1).
+
+The ICI half of the reference's transport (grad/param movement between
+devices) lives in ``parallel/`` as XLA collectives; this package is the
+DCN/host half: SEED-style batched inference serving, env workers,
+parameter pub/sub for host consumers, and the binary wire format.
+"""
+
+from surreal_tpu.distributed.env_worker import run_env_worker
+from surreal_tpu.distributed.inference_server import InferenceServer
+from surreal_tpu.distributed.module_dict import (
+    ModuleDict,
+    dumps_pytree,
+    loads_pytree,
+)
+from surreal_tpu.distributed.param_service import (
+    ParameterClient,
+    ParameterPublisher,
+    ParameterServer,
+)
+
+__all__ = [
+    "run_env_worker",
+    "InferenceServer",
+    "ModuleDict",
+    "dumps_pytree",
+    "loads_pytree",
+    "ParameterClient",
+    "ParameterPublisher",
+    "ParameterServer",
+]

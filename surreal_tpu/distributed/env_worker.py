@@ -1,0 +1,59 @@
+"""Env worker (parity: the reference's ``run_agent`` actor process,
+SURVEY.md §3.2, minus the policy — inference moved to the central server).
+
+Each worker steps a *vectorized slice* of host envs and ships one
+(obs, reward, done) batch per step to the inference server, receiving the
+action batch back. Runs as a thread (tests, small runs) or a subprocess
+(real deployments — MuJoCo releases the GIL poorly); both use the same
+function.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any
+
+import numpy as np
+import zmq
+
+
+def run_env_worker(
+    env_config: Any,
+    server_address: str,
+    worker_id: int,
+    max_steps: int | None = None,
+    stop_event: threading.Event | None = None,
+) -> int:
+    """Step envs against the inference server until ``max_steps`` or
+    ``stop_event``. Returns total env steps executed."""
+    from surreal_tpu.envs import make_env
+
+    env = make_env(env_config)
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.DEALER)
+    sock.setsockopt(zmq.IDENTITY, f"worker-{worker_id}".encode())
+    sock.connect(server_address)
+
+    obs = env.reset(seed=env_config.seed + worker_id)
+    msg: dict = {"obs": obs}
+    steps = 0
+    while (max_steps is None or steps < max_steps) and not (
+        stop_event is not None and stop_event.is_set()
+    ):
+        sock.send(pickle.dumps(msg, protocol=5))
+        if not sock.poll(10_000):
+            raise TimeoutError(f"worker {worker_id}: inference server silent for 10s")
+        actions = pickle.loads(sock.recv())
+        out = env.step(actions)
+        steps += env.num_envs
+        msg = {
+            "obs": out.obs,
+            "reward": out.reward,
+            "done": out.done,
+            "truncated": np.asarray(out.info.get("truncated", np.zeros_like(out.done))),
+            "terminal_obs": out.info.get("terminal_obs", out.obs),
+        }
+    sock.close(0)
+    env.close()
+    return steps

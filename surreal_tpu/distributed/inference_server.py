@@ -1,0 +1,171 @@
+"""SEED-style batched inference server (the north-star replacement for the
+reference's actor pool: "the Agent actor pool collapses into a SEED-RL-
+style batched inference server where env.step observations are shipped to
+a single vmap'd policy.forward on-chip" — BASELINE.json; SURVEY.md §3.2).
+
+Shape: env workers (CPU processes/threads, each stepping a vectorized env
+slice) ship observation batches over ZMQ ROUTER/DEALER; the server
+micro-batches all pending requests into ONE policy forward, then routes
+per-worker action slices back. Behavior-policy info (``action_info``)
+stays server-side and is stitched with the rewards/dones arriving in the
+worker's NEXT request, accumulating time-major trajectory chunks for the
+learner — the ExperienceSender role (SURVEY.md §2.1) without a separate
+replay service hop.
+
+Serialization is pickle protocol 5 (the reference used pyarrow/pickle;
+workers are trusted local processes — this is an internal data plane, not
+an exposed endpoint).
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+import zmq
+
+
+class _WorkerTrack:
+    """Per-worker trajectory assembly state."""
+
+    __slots__ = ("pending", "steps")
+
+    def __init__(self):
+        self.pending: dict | None = None  # {obs, action, info} awaiting outcome
+        self.steps: list[dict] = []
+
+
+class InferenceServer:
+    """Runs the batching loop in a background thread.
+
+    Args:
+      act_fn: (obs [N, ...]) -> (actions [N, ...], info dict of [N, ...])
+        — typically a host-jitted closure over the current learner state;
+        swap via :meth:`set_act_fn` as the learner publishes new params.
+      unroll_length: trajectory chunk length T emitted to ``chunks``.
+      min_batch / max_wait_ms: micro-batching knobs — run the forward once
+        this many worker requests are pending, or after the wait expires.
+    """
+
+    def __init__(
+        self,
+        act_fn: Callable,
+        unroll_length: int = 32,
+        min_batch: int = 1,
+        max_wait_ms: float = 2.0,
+        bind: str = "tcp://127.0.0.1:*",
+    ):
+        self._act_fn = act_fn
+        self._act_lock = threading.Lock()
+        self.unroll_length = unroll_length
+        self.min_batch = min_batch
+        self.max_wait_ms = max_wait_ms
+        self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
+
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.ROUTER)
+        self._sock.bind(bind)
+        self.address = self._sock.getsockopt_string(zmq.LAST_ENDPOINT)
+        self._tracks: dict[bytes, _WorkerTrack] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def set_act_fn(self, act_fn: Callable) -> None:
+        """Swap the policy (e.g. after a learner update). Atomic w.r.t.
+        in-flight batches."""
+        with self._act_lock:
+            self._act_fn = act_fn
+
+    # -- internals -----------------------------------------------------------
+    def _loop(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        pending: list[tuple[bytes, dict]] = []
+        deadline: float | None = None
+        while not self._stop.is_set():
+            timeout = 5.0
+            if pending and deadline is not None:
+                timeout = max(0.0, (deadline - time.monotonic()) * 1000)
+            events = dict(poller.poll(timeout=timeout))
+            if self._sock in events:
+                while True:
+                    try:
+                        ident, payload = self._sock.recv_multipart(zmq.NOBLOCK)
+                    except zmq.Again:
+                        break
+                    msg = pickle.loads(payload)
+                    if not pending:
+                        deadline = time.monotonic() + self.max_wait_ms / 1000
+                    pending.append((ident, msg))
+            ready = len(pending) >= self.min_batch or (
+                pending and deadline is not None and time.monotonic() >= deadline
+            )
+            if ready:
+                self._serve_batch(pending)
+                pending = []
+                deadline = None
+        self._sock.close(0)
+
+    def _serve_batch(self, requests: list[tuple[bytes, dict]]) -> None:
+        obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
+        with self._act_lock:
+            actions, info = self._act_fn(obs)
+        actions = np.asarray(actions)
+        info = {k: np.asarray(v) for k, v in info.items()}
+        offset = 0
+        for ident, msg in requests:
+            n = msg["obs"].shape[0]
+            sl = slice(offset, offset + n)
+            offset += n
+            self._record(ident, msg, actions[sl], {k: v[sl] for k, v in info.items()})
+            self._sock.send_multipart([ident, pickle.dumps(actions[sl], protocol=5)])
+
+    def _record(self, ident: bytes, msg: dict, actions, info) -> None:
+        track = self._tracks.setdefault(ident, _WorkerTrack())
+        if track.pending is not None and "reward" in msg:
+            prev = track.pending
+            done = np.asarray(msg["done"])
+            obs2 = np.asarray(msg["obs"])
+            terminal_obs = np.asarray(msg.get("terminal_obs", obs2))
+            done_b = done.reshape(done.shape + (1,) * (obs2.ndim - 1))
+            truncated = np.asarray(msg.get("truncated", np.zeros_like(done)))
+            track.steps.append(
+                {
+                    "obs": prev["obs"],
+                    "next_obs": np.where(done_b, terminal_obs, obs2),
+                    "action": prev["action"],
+                    "reward": np.asarray(msg["reward"]),
+                    "done": done,
+                    "terminated": done & ~truncated,
+                    "behavior_logp": prev["info"]["logp"],
+                    "behavior": {
+                        k: v
+                        for k, v in prev["info"].items()
+                        if k in ("mean", "log_std", "logits")
+                    },
+                }
+            )
+        track.pending = {"obs": np.asarray(msg["obs"]), "action": actions, "info": info}
+        if len(track.steps) >= self.unroll_length:
+            chunk = {
+                k: (
+                    {kk: np.stack([s[k][kk] for s in track.steps]) for kk in track.steps[0][k]}
+                    if isinstance(track.steps[0][k], dict)
+                    else np.stack([s[k] for s in track.steps])
+                )
+                for k in track.steps[0]
+            }
+            track.steps = []
+            try:
+                self.chunks.put_nowait(chunk)
+            except queue.Full:
+                pass  # learner is behind; drop oldest-policy data (on-policy bias)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)

@@ -1,0 +1,44 @@
+"""Parameter wire format (parity: reference
+``surreal/distributed/module_dict.py`` — named dict of modules with binary
+``dumps()/loads()``; SURVEY.md §2.1).
+
+The reference serialized torch modules; here the unit is a *pytree of
+arrays* (flax params / full learner states). msgpack via
+``flax.serialization`` gives a compact, python-version-independent binary
+— the format that crosses ZMQ between the learner process and any host
+consumer (eval workers, param clients).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from flax import serialization
+
+
+class ModuleDict:
+    """Named bundle of pytrees with a stable binary encoding."""
+
+    def __init__(self, modules: dict[str, Any]):
+        self.modules = dict(modules)
+
+    def dumps(self) -> bytes:
+        return serialization.to_bytes(
+            {name: jax.device_get(tree) for name, tree in self.modules.items()}
+        )
+
+    def loads(self, data: bytes) -> dict[str, Any]:
+        """Restore into the shapes/dtypes of the current modules (the
+        template pytree defines the structure, as flax requires)."""
+        restored = serialization.from_bytes(self.modules, data)
+        self.modules = restored
+        return restored
+
+
+def dumps_pytree(tree: Any) -> bytes:
+    return serialization.to_bytes(jax.device_get(tree))
+
+
+def loads_pytree(template: Any, data: bytes) -> Any:
+    return serialization.from_bytes(template, data)

@@ -1,0 +1,126 @@
+"""Parameter distribution over DCN (parity: reference
+``surreal/distributed/ps.py`` — ParameterPublisher -> ParameterServer ->
+ParameterClient, and the ShardedParameterServer variant; SURVEY.md §2.1).
+
+ON-DEVICE, THIS LAYER IS GONE — that is the point of the rebuild: learner
+and inference share device memory in one SPMD program, so "publishing" is
+a no-op and the PS role collapses (SURVEY.md §5.8). This module exists for
+the capability that remains real on the HOST side: shipping parameters to
+processes outside the SPMD program — eval workers on other machines,
+external consumers — over pyzmq, exactly the reference's pub/sub + req/rep
+shape.
+
+Sharding note: the reference sharded its PS because one process couldn't
+serve 1000 actor clients. Here the client population is a handful of eval
+workers (actors collapsed into the program), so one server suffices; the
+class still accepts multiple bind addresses for parity with
+ShardedParameterServer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import zmq
+
+from surreal_tpu.distributed.module_dict import dumps_pytree, loads_pytree
+
+
+class ParameterPublisher:
+    """Learner-side: publish (version, params) snapshots (PUB socket)."""
+
+    def __init__(self, bind: str = "tcp://127.0.0.1:*"):
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        self._sock.bind(bind)
+        self.address = self._sock.getsockopt_string(zmq.LAST_ENDPOINT)
+        self._version = 0
+
+    def publish(self, params: Any) -> int:
+        self._version += 1
+        self._sock.send_multipart(
+            [b"params", self._version.to_bytes(8, "little"), dumps_pytree(params)]
+        )
+        return self._version
+
+    def close(self) -> None:
+        self._sock.close(0)
+
+
+class ParameterServer:
+    """Caches the latest published params; serves REQ/REP fetches.
+
+    Runs a background thread (SUB from the publisher, REP to clients) —
+    the reference's standalone PS process shrunk to a thread.
+    """
+
+    def __init__(self, publisher_address: str, bind: str = "tcp://127.0.0.1:*"):
+        self._ctx = zmq.Context.instance()
+        self._sub = self._ctx.socket(zmq.SUB)
+        self._sub.connect(publisher_address)
+        self._sub.setsockopt(zmq.SUBSCRIBE, b"params")
+        self._rep = self._ctx.socket(zmq.REP)
+        self._rep.bind(bind)
+        self.address = self._rep.getsockopt_string(zmq.LAST_ENDPOINT)
+        self._latest: tuple[int, bytes] | None = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        poller = zmq.Poller()
+        poller.register(self._sub, zmq.POLLIN)
+        poller.register(self._rep, zmq.POLLIN)
+        while not self._stop.is_set():
+            for sock, _ in poller.poll(timeout=50):
+                if sock is self._sub:
+                    _, ver, blob = self._sub.recv_multipart()
+                    with self._lock:
+                        self._latest = (int.from_bytes(ver, "little"), blob)
+                elif sock is self._rep:
+                    self._rep.recv()  # any request payload = "give me latest"
+                    with self._lock:
+                        latest = self._latest
+                    if latest is None:
+                        self._rep.send_multipart([b"none", b""])
+                    else:
+                        ver, blob = latest
+                        self._rep.send_multipart(
+                            [ver.to_bytes(8, "little"), blob]
+                        )
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._sub.close(0)
+        self._rep.close(0)
+
+
+class ParameterClient:
+    """Actor/eval-side: fetch the latest params when asked (REQ socket) —
+    the reference agents' periodic parameter fetch (SURVEY.md §3.2)."""
+
+    def __init__(self, server_address: str, template: Any):
+        self._ctx = zmq.Context.instance()
+        self._req = self._ctx.socket(zmq.REQ)
+        self._req.connect(server_address)
+        self.template = template
+        self.version = 0
+
+    def fetch(self, timeout_ms: int = 5000) -> Any | None:
+        """Returns the latest params pytree, or None if nothing published
+        yet / timeout. Updates ``self.version``."""
+        self._req.send(b"fetch")
+        if not self._req.poll(timeout_ms):
+            raise TimeoutError("parameter server did not reply")
+        ver, blob = self._req.recv_multipart()
+        if ver == b"none":
+            return None
+        self.version = int.from_bytes(ver, "little")
+        return loads_pytree(self.template, blob)
+
+    def close(self) -> None:
+        self._req.close(0)

@@ -1,0 +1,114 @@
+"""SEED trainer: central inference server + host env workers + learner —
+the fully-disaggregated topology for envs that cannot live on device
+(BASELINE config ⑤'s "SEED-RL batched inference"; reference call stack
+SURVEY.md §3.2 with the actor pool collapsed).
+
+Data flow:
+  env workers --ZMQ/DCN--> InferenceServer (one batched policy forward)
+     └─ trajectory chunks --queue--> learner.learn (V-trace corrects the
+        one-update staleness; works for IMPALA and, with staleness caveats,
+        PPO)
+
+Workers default to threads (fine for gym classic-control; MuJoCo-heavy
+envs should use ``worker_mode='process'``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+from surreal_tpu.distributed.env_worker import run_env_worker
+from surreal_tpu.distributed.inference_server import InferenceServer
+from surreal_tpu.learners import build_learner
+from surreal_tpu.session.tracker import PeriodicTracker
+
+
+class SEEDTrainer:
+    def __init__(self, config, worker_mode: str = "thread"):
+        self.config = config
+        from surreal_tpu.envs import make_env
+
+        # build one env to read specs, then close (workers build their own)
+        probe = make_env(config.env_config)
+        self.specs = probe.specs
+        probe.close()
+        self.learner = build_learner(config.learner_config, self.specs)
+        self.algo = self.learner.config.algo
+        self.num_workers = max(1, config.session_config.topology.num_env_workers)
+        self.worker_mode = worker_mode
+
+        self._jit_act = jax.jit(self.learner.act, static_argnames="mode")
+        self._learn = jax.jit(self.learner.learn)
+
+    def _make_act_fn(self, state, key_holder):
+        def act_fn(obs_np):
+            key_holder[0], sub = jax.random.split(key_holder[0])
+            actions, info = self._jit_act(state, obs_np, sub, mode="training")
+            return np.asarray(actions), {k: np.asarray(v) for k, v in info.items()}
+
+        return act_fn
+
+    def run(
+        self,
+        max_env_steps: int | None = None,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ):
+        cfg = self.config.session_config
+        total = max_env_steps or cfg.total_env_steps
+        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
+
+        key = jax.random.key(cfg.seed)
+        key, init_key, act_key = jax.random.split(key, 3)
+        state = self.learner.init(init_key)
+        key_holder = [act_key]
+
+        server = InferenceServer(
+            act_fn=self._make_act_fn(state, key_holder),
+            unroll_length=self.algo.horizon,
+        )
+        stop = threading.Event()
+        workers = []
+        env_cfg = self.config.env_config
+        for i in range(self.num_workers):
+            t = threading.Thread(
+                target=run_env_worker,
+                args=(env_cfg, server.address, i),
+                kwargs={"stop_event": stop},
+                daemon=True,
+            )
+            t.start()
+            workers.append(t)
+
+        env_steps = 0
+        iteration = 0
+        last_metrics: dict = {}
+        t0 = time.time()
+        try:
+            while env_steps < total:
+                try:
+                    chunk = server.chunks.get(timeout=30)
+                except queue.Empty:
+                    raise TimeoutError("no experience chunks arriving from workers")
+                batch = jax.device_put(chunk)
+                key, lkey = jax.random.split(key)
+                state, metrics = self._learn(state, batch, lkey)
+                server.set_act_fn(self._make_act_fn(state, key_holder))
+                iteration += 1
+                env_steps += chunk["reward"].shape[0] * chunk["reward"].shape[1]
+                if metrics_every.track_increment():
+                    m = {k: float(v) for k, v in metrics.items()}
+                    m["time/env_steps"] = env_steps
+                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
+                    last_metrics = m
+                    if on_metrics and on_metrics(iteration, m):
+                        break
+        finally:
+            stop.set()
+            server.close()
+        return state, last_metrics

@@ -1,0 +1,193 @@
+"""IMPALA/V-trace learner (BASELINE config ⑤ — beyond the reference, which
+shipped PPO/DDPG only; SURVEY.md §6). Actor-learner decoupling with
+off-policy correction: behavior-policy log-probs ride with the experience
+(the reference's ``action_info`` pattern, SURVEY.md §3.2) and V-trace
+corrects the staleness, which is exactly what the SEED-style serving path
+introduces.
+
+One update per batch (no epochs/minibatches — IMPALA's design), so the
+whole learn is a single fused backward pass; V-trace is the reverse scan
+in ``ops/vtrace.py``. Shares the PPO batch contract, so the same Trainer
+and collectors drive it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from surreal_tpu.envs.base import EnvSpecs
+from surreal_tpu.learners.base import EVAL_DETERMINISTIC, TRAINING, Learner
+from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
+from surreal_tpu.ops import distributions as D
+from surreal_tpu.ops.running_stats import RunningStats, init_stats, normalize, update_stats
+from surreal_tpu.ops.vtrace import vtrace_nextobs
+from surreal_tpu.session.config import Config
+
+IMPALA_LEARNER_CONFIG = Config(
+    algo=Config(
+        name="impala",
+        horizon=64,           # unroll length per learner batch
+        clip_rho=1.0,
+        clip_c=1.0,
+        clip_pg_rho=1.0,
+        value_coeff=0.5,
+        entropy_coeff=0.01,
+        init_log_std=-0.5,    # continuous-action variant
+    ),
+    optimizer=Config(lr=6e-4),
+    replay=Config(kind="fifo"),
+)
+
+
+class IMPALAState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    obs_stats: RunningStats
+    iteration: jax.Array
+
+
+class IMPALALearner(Learner):
+    def __init__(self, learner_config, env_specs: EnvSpecs):
+        super().__init__(learner_config, env_specs)
+        self.discrete = env_specs.discrete
+        if self.discrete:
+            self.model = CategoricalPPOModel(
+                model_cfg=learner_config.model.to_dict(),
+                n_actions=env_specs.action.n,
+            )
+        else:
+            self.model = PPOModel(
+                model_cfg=learner_config.model.to_dict(),
+                act_dim=int(env_specs.action.shape[0]),
+                init_log_std=learner_config.algo.init_log_std,
+            )
+        opt_cfg = learner_config.optimizer
+        if opt_cfg.lr_schedule == "linear":
+            lr = optax.linear_schedule(
+                opt_cfg.lr, 0.0, transition_steps=opt_cfg.get("anneal_steps", 10_000)
+            )
+        else:
+            lr = opt_cfg.lr
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(opt_cfg.max_grad_norm),
+            optax.adam(lr),
+        )
+
+    def init(self, key: jax.Array) -> IMPALAState:
+        obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
+        params = self.model.init(key, obs)
+        return IMPALAState(
+            params=params,
+            opt_state=self.tx.init(params),
+            obs_stats=init_stats(self.specs.obs.shape)
+            if self._use_obs_filter
+            else init_stats((1,)),
+            iteration=jnp.zeros((), jnp.int32),
+        )
+
+    @property
+    def _use_obs_filter(self) -> bool:
+        return (
+            bool(self.config.algo.use_obs_filter)
+            and self.specs.obs.dtype != np.uint8
+        )
+
+    def _norm_obs(self, stats: RunningStats, obs: jax.Array) -> jax.Array:
+        if not self._use_obs_filter:
+            return obs
+        return normalize(stats, obs.astype(jnp.float32))
+
+    # -- acting (same behavior-info contract as PPO) --------------------------
+    def act(self, state: IMPALAState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
+        out = self.model.apply(state.params, self._norm_obs(state.obs_stats, obs))
+        if self.discrete:
+            if mode == EVAL_DETERMINISTIC:
+                action = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+            else:
+                action = D.categorical_sample(key, out.logits).astype(jnp.int32)
+            logp = D.categorical_logp(out.logits, action)
+            return action, {"logp": logp, "logits": out.logits, "value": out.value}
+        if mode == EVAL_DETERMINISTIC:
+            action = out.mean
+        else:
+            action = D.diag_gauss_sample(key, out.mean, out.log_std)
+        logp = D.diag_gauss_logp(out.mean, out.log_std, action)
+        return action, {
+            "logp": logp, "mean": out.mean, "log_std": out.log_std, "value": out.value
+        }
+
+    # -- learning ------------------------------------------------------------
+    def learn(self, state: IMPALAState, batch: dict, key: jax.Array, axis_name=None):
+        del key
+        algo = self.config.algo
+        if self._use_obs_filter:
+            obs_stats = update_stats(state.obs_stats, batch["obs"], axis_name=axis_name)
+        else:
+            obs_stats = state.obs_stats
+        obs = self._norm_obs(obs_stats, batch["obs"])
+        next_obs = self._norm_obs(obs_stats, batch["next_obs"])
+
+        def loss_fn(params):
+            out = self.model.apply(params, obs)
+            values = out.value
+            values_next = self.model.apply(params, next_obs).value
+            if self.discrete:
+                logp = D.categorical_logp(out.logits, batch["action"])
+                entropy = D.categorical_entropy(out.logits).mean()
+            else:
+                logp = D.diag_gauss_logp(out.mean, out.log_std, batch["action"])
+                entropy = D.diag_gauss_entropy(out.log_std).mean()
+
+            vt = vtrace_nextobs(
+                behaviour_logp=batch["behavior_logp"],
+                target_logp=jax.lax.stop_gradient(logp),
+                rewards=batch["reward"],
+                values=jax.lax.stop_gradient(values),
+                values_next=jax.lax.stop_gradient(values_next),
+                done=batch["done"],
+                terminated=batch["terminated"],
+                gamma=algo.gamma,
+                clip_rho=algo.clip_rho,
+                clip_c=algo.clip_c,
+                clip_pg_rho=algo.clip_pg_rho,
+            )
+            pg_loss = -(vt.pg_advantages * logp).mean()
+            v_loss = 0.5 * ((values - vt.vs) ** 2).mean()
+            total = pg_loss + algo.value_coeff * v_loss - algo.entropy_coeff * entropy
+            return total, {
+                "pg_loss": pg_loss,
+                "v_loss": v_loss,
+                "entropy": entropy,
+                "rho_mean": jnp.exp(
+                    jax.lax.stop_gradient(logp) - batch["behavior_logp"]
+                ).mean(),
+            }
+
+        grads, aux = jax.grad(loss_fn, has_aux=True)(state.params)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+            aux = jax.lax.pmean(aux, axis_name)
+        updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+
+        new_state = IMPALAState(
+            params=params,
+            opt_state=opt_state,
+            obs_stats=obs_stats,
+            iteration=state.iteration + 1,
+        )
+        metrics = {
+            "loss/pg": aux["pg_loss"],
+            "loss/value": aux["v_loss"],
+            "policy/entropy": aux["entropy"],
+            "policy/rho_mean": aux["rho_mean"],
+        }
+        return new_state, metrics
+
+    def default_config(self):
+        return IMPALA_LEARNER_CONFIG

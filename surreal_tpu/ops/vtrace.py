@@ -65,3 +65,64 @@ def vtrace(
     pg_advantages = clipped_pg_rhos * (rewards + discounts * vs_next - values[:-1])
 
     return VTraceOutput(vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages))
+
+
+def vtrace_nextobs(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    values_next: jax.Array,
+    done: jax.Array,
+    terminated: jax.Array,
+    gamma: float,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+    clip_pg_rho: float = 1.0,
+) -> VTraceOutput:
+    """V-trace over auto-reset trajectories with exact truncation handling
+    (the same two-mask scheme as the PPO learner's GAE):
+
+    - bootstrap discount ``gamma*(1-terminated)`` pairs with
+      ``values_next`` = V(pre-reset successor obs), so truncated episodes
+      still bootstrap;
+    - the recursion's cross-step correction is cut at EVERY episode
+      boundary (``done``), so corrections never leak across resets.
+
+    All args are time-major [T, ...]; ``values``/``values_next`` are the
+    learner's V(s_t) / V(s'_t).
+    """
+    log_rhos = target_logp - behaviour_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+
+    boot_disc = gamma * (1.0 - terminated.astype(rewards.dtype))
+    edge = 1.0 - done.astype(rewards.dtype)
+
+    deltas = clipped_rhos * (rewards + boot_disc * values_next - values)
+
+    def step(carry, xs):
+        delta_t, edge_t, c_t = xs
+        acc = delta_t + gamma * edge_t * c_t * carry
+        return acc, acc
+
+    _, acc_rev = lax.scan(
+        step,
+        jnp.zeros_like(values[-1]),
+        (deltas[::-1], edge[::-1], cs[::-1]),
+    )
+    vs = acc_rev[::-1] + values
+
+    # pg advantage: q_t = r + boot_disc * (vs of the successor); at episode
+    # boundaries the successor lives in the next episode, so fall back to
+    # the value estimate of the terminal obs.
+    vs_shift = jnp.concatenate([vs[1:], values_next[-1:]], axis=0)
+    done_f = done.astype(rewards.dtype)
+    vs_next = done_f * values_next + (1.0 - done_f) * vs_shift
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    pg_advantages = clipped_pg_rhos * (rewards + boot_disc * vs_next - values)
+
+    return VTraceOutput(
+        vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages)
+    )

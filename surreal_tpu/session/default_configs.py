@@ -100,9 +100,15 @@ BASE_SESSION_CONFIG = Config(
 
 
 def base_config() -> Config:
-    """The full three-tree default bundle."""
+    """The three-tree default bundle.
+
+    ``learner_config`` is deliberately EMPTY here: the learner tree layers
+    as user-overrides -> per-algorithm defaults -> BASE_LEARNER_CONFIG
+    inside ``learners.build_learner``. Materializing BASE defaults into the
+    user tree at bundle time would turn them into explicit "user" values
+    that silently stomp per-algorithm defaults (e.g. IMPALA's lr)."""
     return Config(
-        learner_config=BASE_LEARNER_CONFIG,
+        learner_config=Config(),
         env_config=BASE_ENV_CONFIG,
         session_config=BASE_SESSION_CONFIG,
     )
