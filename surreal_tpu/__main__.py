@@ -1,0 +1,7 @@
+"""``python -m surreal_tpu`` — the console entry (SURVEY.md §3.1)."""
+
+import sys
+
+from surreal_tpu.main.launch import main
+
+sys.exit(main())

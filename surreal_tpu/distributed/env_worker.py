@@ -26,9 +26,15 @@ def run_env_worker(
     stop_event: threading.Event | None = None,
 ) -> int:
     """Step envs against the inference server until ``max_steps`` or
-    ``stop_event``. Returns total env steps executed."""
-    from surreal_tpu.envs import make_env
+    ``stop_event``. Returns total env steps executed.
 
+    Runs unchanged as a thread or a spawned subprocess; in the latter case
+    ``env_config`` arrives as a plain picklable dict and is rehydrated.
+    """
+    from surreal_tpu.envs import make_env
+    from surreal_tpu.session.config import Config
+
+    env_config = Config(env_config)
     env = make_env(env_config)
     ctx = zmq.Context.instance()
     sock = ctx.socket(zmq.DEALER)

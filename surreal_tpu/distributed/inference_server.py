@@ -61,6 +61,7 @@ class InferenceServer:
     ):
         self._act_fn = act_fn
         self._act_lock = threading.Lock()
+        self._version = 0  # params version; bumped by every set_act_fn
         self.unroll_length = unroll_length
         self.min_batch = min_batch
         self.max_wait_ms = max_wait_ms
@@ -77,9 +78,18 @@ class InferenceServer:
 
     def set_act_fn(self, act_fn: Callable) -> None:
         """Swap the policy (e.g. after a learner update). Atomic w.r.t.
-        in-flight batches."""
+        in-flight batches; bumps the params version that tags every
+        transition acted from here on (SURVEY.md §7 hard-parts: async
+        on-policy correctness needs a params-version tag per transition)."""
         with self._act_lock:
             self._act_fn = act_fn
+            self._version += 1
+
+    @property
+    def version(self) -> int:
+        """Current params version (== number of set_act_fn calls)."""
+        with self._act_lock:
+            return self._version
 
     # -- internals -----------------------------------------------------------
     def _loop(self) -> None:
@@ -115,6 +125,7 @@ class InferenceServer:
         obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
         with self._act_lock:
             actions, info = self._act_fn(obs)
+            info = dict(info, param_version=np.full(len(obs), self._version, np.int32))
         actions = np.asarray(actions)
         info = {k: np.asarray(v) for k, v in info.items()}
         offset = 0
@@ -148,6 +159,10 @@ class InferenceServer:
                         for k, v in prev["info"].items()
                         if k in ("mean", "log_std", "logits")
                     },
+                    # version of the params that CHOSE this action — the
+                    # staleness bookkeeping PPO-over-SEED needs to drop or
+                    # correct windows acted by long-dead policies
+                    "param_version": prev["info"]["param_version"],
                 }
             )
         track.pending = {"obs": np.asarray(msg["obs"]), "action": actions, "info": info}
@@ -161,10 +176,19 @@ class InferenceServer:
                 for k in track.steps[0]
             }
             track.steps = []
-            try:
-                self.chunks.put_nowait(chunk)
-            except queue.Full:
-                pass  # learner is behind; drop oldest-policy data (on-policy bias)
+            while True:
+                try:
+                    self.chunks.put_nowait(chunk)
+                    break
+                except queue.Full:
+                    # learner is behind: evict the OLDEST queued chunk so
+                    # the freshest policy's data survives (dropping the new
+                    # chunk instead would starve a lagging learner on
+                    # ever-staler experience)
+                    try:
+                        self.chunks.get_nowait()
+                    except queue.Empty:
+                        pass
 
     def close(self) -> None:
         self._stop.set()

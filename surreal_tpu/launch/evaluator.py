@@ -1,0 +1,130 @@
+"""Periodic policy evaluation (parity: reference ``run_eval`` /
+``run_evals`` — dedicated eval workers stepping a ``VideoWrapper``-wrapped
+env with an agent in eval mode, deterministic or stochastic; SURVEY.md
+§3.5 and §2.1 Main-dispatch row).
+
+The reference ran evals as separate processes that re-fetched parameters
+from the PS each episode. Here the evaluator is called from the training
+loop with the live learner state (shared device memory — no fetch), acting
+through the :class:`~surreal_tpu.agents.base.Agent` eval view:
+
+- **device envs** (``jax:*``): all ``episodes`` run as one vmapped,
+  jitted, done-latched scan — an eval is one device dispatch.
+- **host envs** (gym/dm_control): a separate env instance (so eval never
+  perturbs training env state), with video recording wired per
+  ``env_config.video`` — eval is where the reference recorded videos, and
+  the rebuild keeps that: the training path never constructs VideoWrapper.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.agents import Agent
+from surreal_tpu.envs import is_jax_env, make_env
+from surreal_tpu.learners.base import EVAL_DETERMINISTIC, EVAL_STOCHASTIC
+from surreal_tpu.session.config import Config
+
+
+class Evaluator:
+    """Scores learner state over N fresh episodes; returns ``eval/*`` metrics."""
+
+    def __init__(self, env_config, eval_config, learner):
+        self.episodes = int(eval_config.episodes)
+        mode = (
+            EVAL_DETERMINISTIC
+            if eval_config.mode == "deterministic"
+            else EVAL_STOCHASTIC
+        )
+        self.agent = Agent(learner, mode)
+        self._jax_eval = None
+        # eval owns its env instance; host eval uses `episodes` parallel envs
+        probe = make_env(env_config)
+        if is_jax_env(probe):
+            self.env = probe
+            self._time_limit = self.env.time_limit or 1000
+            self._jax_eval = jax.jit(self._device_eval)
+        else:
+            probe.close()
+            self.env = make_env(
+                Config(num_envs=self.episodes).extend(env_config)
+            )
+            self._time_limit = 10_000  # hard cap on host eval stepping
+            self._host_act = jax.jit(self.agent.act)  # one cache for all evals
+
+    # -- device path ---------------------------------------------------------
+    def _device_eval(self, state, key):
+        # distinct folds for resets vs per-step action keys: split(k, n) is
+        # a PREFIX of split(k, m>n), so reusing `key` for both would make
+        # episode i's reset key identical to step i's action key
+        reset_key = jax.random.fold_in(key, 0)
+        step_key = jax.random.fold_in(key, 1)
+        env_state, obs = jax.vmap(self.env.reset)(
+            jax.random.split(reset_key, self.episodes)
+        )
+        B = self.episodes
+
+        def step(carry, k):
+            env_state, obs, ret, length, alive, success = carry
+            action, _ = self.agent.act(state, obs, k)
+            env_state, obs2, reward, done, info = jax.vmap(self.env.step)(
+                env_state, action
+            )
+            ret = ret + reward * alive
+            length = length + alive.astype(jnp.int32)
+            if "success" in info:
+                success = success | (info["success"] & (alive > 0))
+            alive = alive * (1.0 - done.astype(jnp.float32))
+            return (env_state, obs2, ret, length, alive, success), None
+
+        init = (
+            env_state,
+            obs,
+            jnp.zeros(B, jnp.float32),
+            jnp.zeros(B, jnp.int32),
+            jnp.ones(B, jnp.float32),
+            jnp.zeros(B, bool),
+        )
+        (_, _, ret, length, _, success), _ = jax.lax.scan(
+            step, init, jax.random.split(step_key, self._time_limit)
+        )
+        return {
+            "eval/return": ret.mean(),
+            "eval/length": length.astype(jnp.float32).mean(),
+            "eval/success": success.astype(jnp.float32).mean(),
+        }
+
+    # -- host path -----------------------------------------------------------
+    def _host_eval(self, state, key):
+        env = self.env
+        obs = env.reset()
+        B = env.num_envs
+        ret = np.zeros(B, np.float32)
+        length = np.zeros(B, np.int32)
+        alive = np.ones(B, bool)
+        for _ in range(self._time_limit):
+            key, akey = jax.random.split(key)
+            action, _ = self._host_act(state, jnp.asarray(obs), akey)
+            out = env.step(np.asarray(action))
+            ret += out.reward * alive
+            length += alive.astype(np.int32)
+            alive &= ~out.done
+            obs = out.obs
+            if not alive.any():
+                break
+        return {
+            "eval/return": float(ret.mean()),
+            "eval/length": float(length.mean()),
+        }
+
+    def evaluate(self, state, key: jax.Array) -> dict[str, float]:
+        if self._jax_eval is not None:
+            out = self._jax_eval(state, key)
+            return {k: float(v) for k, v in out.items()}
+        return self._host_eval(state, key)
+
+    def close(self) -> None:
+        if self._jax_eval is None:
+            self.env.close()

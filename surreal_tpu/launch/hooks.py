@@ -1,0 +1,251 @@
+"""Session-services hooks shared by every training driver: metrics writing,
+periodic checkpoint with keep-best, periodic eval, restore/auto-resume, and
+an optional profiler trace window.
+
+Parity map (SURVEY.md §3.4 learner loop + §2.1): the reference's learner
+main loop interleaved ``tensorplex scalars``, ``PeriodicCheckpoint.save()``
+and parameter publishing, while separate eval processes scored checkpoints
+(§3.5) — here those side-bands are one :class:`SessionHooks` object called
+once per iteration from Trainer / OffPolicyTrainer / SEEDTrainer, so the
+three drivers cannot drift in their observability behavior.
+
+Restore semantics (§5.3/§5.4): ``checkpoint.restore_from`` names another
+session folder to warm-start from (the reference's ``restore_folder``);
+``checkpoint.auto_resume`` (default on) resumes from this session's own
+latest checkpoint when present — which is the whole failure-recovery
+story: a killed job relaunched with the same config continues its curve.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+from surreal_tpu.session.checkpoint import CheckpointManager, make_checkpoint_manager
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.metrics import get_logger, make_metrics_writer
+from surreal_tpu.session.tracker import PeriodicTracker
+
+
+class SessionHooks:
+    """One per training run. Driver contract:
+
+        hooks = SessionHooks(config, learner)
+        try:
+            state, it, steps = hooks.restore(state)    # once, before the loop
+            hooks.begin_run(it, steps)
+            while ...:
+                ...train...
+                m, stop = hooks.end_iteration(
+                    it, steps, state, key, metrics, on_metrics
+                )
+                if stop: break
+            hooks.final_checkpoint(it, steps, state)
+        finally:
+            hooks.close()
+
+    ``end_iteration`` owns the metrics cadence: it syncs device scalars to
+    host floats only when ``metrics.every_n_iters`` fires (keeping the hot
+    loop async), fires eval/checkpoint/profiler on their own cadences, and
+    forwards fired metrics to the caller's ``on_metrics``.
+    """
+
+    def __init__(self, config, learner, name: str = "train"):
+        self.config = config
+        cfg = config.session_config
+        os.makedirs(cfg.folder, exist_ok=True)
+        self.log = get_logger(name, cfg.folder)
+        self.writer = make_metrics_writer(cfg, name=name)
+        self.ckpt: CheckpointManager | None = make_checkpoint_manager(cfg)
+        self._ckpt_every = PeriodicTracker(max(1, cfg.checkpoint.every_n_iters))
+
+        self.evaluator = None
+        ev = cfg.eval
+        if ev.every_n_iters and ev.every_n_iters > 0 and ev.episodes > 0:
+            from surreal_tpu.launch.evaluator import Evaluator
+
+            self.evaluator = Evaluator(config.env_config, ev, learner)
+            self._eval_every = PeriodicTracker(ev.every_n_iters)
+        if self.ckpt is not None:
+            self.ckpt.best_key = (
+                "eval/return" if self.evaluator else "episode/return"
+            )
+
+        prof = cfg.profiler
+        self._prof_enabled = bool(prof.enabled)
+        self._prof_start = int(prof.start_iter)
+        self._prof_stop = int(prof.start_iter) + int(prof.num_iters)
+        self._prof_active = False
+        self._last_eval: dict[str, float] = {}
+        self._last_train: dict[str, float] = {}
+        self._metrics_every = PeriodicTracker(max(1, cfg.metrics.every_n_iters))
+        self._t0 = None
+        self._steps0 = 0
+
+    @property
+    def last_metrics(self) -> dict[str, float]:
+        """Latest synced train metrics merged with latest eval metrics."""
+        return {**self._last_train, **self._last_eval}
+
+    # -- restore -------------------------------------------------------------
+    def restore(self, init_state):
+        """-> (state, start_iteration, start_env_steps).
+
+        Own-folder auto-resume takes precedence over ``restore_from``: a
+        warm-started job that crashes and relaunches with the same config
+        must continue its OWN curve, not re-warm-start from the foreign
+        folder; restore_from only seeds the very first run."""
+        cfg = self.config.session_config.checkpoint
+        if cfg.auto_resume and self.ckpt is not None:
+            restored = self.ckpt.restore(init_state)
+            if restored is not None:
+                state, meta = restored
+                self.log.info(
+                    "auto-resumed at iteration %d (%d env steps)",
+                    meta["iteration"], meta["env_steps"],
+                )
+                self._reseed_cadences(int(meta["iteration"]))
+                return state, int(meta["iteration"]), int(meta["env_steps"])
+        if cfg.restore_from:
+            mgr = CheckpointManager(cfg.restore_from)
+            restored = mgr.restore(init_state)
+            mgr.close()
+            if restored is None:
+                raise FileNotFoundError(
+                    f"checkpoint.restore_from={cfg.restore_from!r} has no checkpoint"
+                )
+            state, meta = restored
+            self.log.info(
+                "restored from %s at iteration %d (%d env steps)",
+                cfg.restore_from, meta["iteration"], meta["env_steps"],
+            )
+            # warm-start from foreign folder: keep its counters so schedules
+            # (lr anneal, beta anneal) continue rather than restart
+            self._reseed_cadences(int(meta["iteration"]))
+            return state, int(meta["iteration"]), int(meta["env_steps"])
+        return init_state, 0, 0
+
+    def _reseed_cadences(self, iteration: int) -> None:
+        self._ckpt_every = PeriodicTracker(
+            self._ckpt_every.period, init_count=iteration
+        )
+        if self.evaluator is not None:
+            self._eval_every = PeriodicTracker(
+                self._eval_every.period, init_count=iteration
+            )
+
+    # -- per-iteration -------------------------------------------------------
+    def begin_run(self, iteration: int, env_steps: int) -> None:
+        """Start the wall-clock + cadence counters from the (possibly
+        resumed) position."""
+        self._metrics_every = PeriodicTracker(
+            self._metrics_every.period, init_count=iteration
+        )
+        self._t0 = time.time()
+        self._steps0 = env_steps
+
+    def end_iteration(
+        self,
+        iteration: int,
+        env_steps: int,
+        state,
+        key: jax.Array,
+        metrics=None,
+        on_metrics=None,
+    ):
+        """Per-iteration side-bands, shared verbatim by every driver.
+
+        ``metrics`` is the iteration's metric scalars — a dict of device
+        scalars, or a zero-arg callable returning one (to defer assembling
+        host-side extras) — synced to host floats only when the metrics
+        cadence fires. Returns (synced_metrics_or_None, stop) where stop
+        echoes a truthy ``on_metrics(iteration, m)``.
+        """
+        m = None
+        if self._metrics_every.track_increment():
+            raw = metrics() if callable(metrics) else (metrics or {})
+            m = {k: float(v) for k, v in raw.items()}
+            m["time/env_steps"] = env_steps
+            m["time/env_steps_per_s"] = (env_steps - self._steps0) / max(
+                time.time() - (self._t0 or time.time()), 1e-9
+            )
+            self._last_train = m
+        evaled: dict[str, float] = {}
+        if self.evaluator is not None and self._eval_every.track_increment():
+            evaled = self.evaluator.evaluate(state, key)
+            self._last_eval = evaled
+        if m or evaled:
+            self.writer.write(env_steps, {**(m or {}), **evaled})
+        if self.ckpt is not None and self._ckpt_every.track_increment():
+            self.ckpt.save(
+                iteration,
+                state,
+                env_steps=env_steps,
+                metrics=self.last_metrics,
+            )
+        self._profiler_tick(iteration)
+        stop = m is not None and on_metrics is not None and bool(
+            on_metrics(iteration, m)
+        )
+        return m, stop
+
+    def final_checkpoint(self, iteration: int, env_steps: int, state) -> None:
+        """Always leave a resumable checkpoint at run end."""
+        if self.ckpt is not None and self.ckpt.latest_step() != iteration:
+            self.ckpt.save(
+                iteration,
+                state,
+                env_steps=env_steps,
+                metrics={**self._last_train, **self._last_eval},
+            )
+
+    def _profiler_tick(self, iteration: int) -> None:
+        if not self._prof_enabled:
+            return
+        if not self._prof_active and iteration >= self._prof_start:
+            if iteration < self._prof_stop:
+                trace_dir = os.path.join(
+                    self.config.session_config.folder, "profile"
+                )
+                jax.profiler.start_trace(trace_dir)
+                self._prof_active = True
+                self.log.info("profiler trace started -> %s", trace_dir)
+        elif self._prof_active and iteration >= self._prof_stop:
+            jax.profiler.stop_trace()
+            self._prof_active = False
+            self._prof_enabled = False  # one window per run
+            self.log.info("profiler trace stopped")
+
+    def close(self) -> None:
+        if self._prof_active:
+            jax.profiler.stop_trace()
+            self._prof_active = False
+        if self.evaluator is not None:
+            self.evaluator.close()
+        if self.ckpt is not None:
+            self.ckpt.close()
+        self.writer.close()
+
+
+def host_metrics(metrics, recent_returns, window: int = 20):
+    """Deferred host-metrics assembly for host-env loops: the learner's
+    metric scalars plus a rolling-mean ``episode/return`` from the env
+    wrappers' completed-episode stats. Returns a zero-arg callable for
+    ``SessionHooks.end_iteration`` (synced only when the cadence fires)."""
+    import numpy as np
+
+    def build():
+        m = dict(metrics)
+        if recent_returns:
+            m["episode/return"] = float(np.mean(recent_returns[-window:]))
+        return m
+
+    return build
+
+
+def training_env_config(env_config) -> Config:
+    """The training env never records video — that is eval's job (the
+    reference wired VideoWrapper only into ``run_eval``, SURVEY.md §3.5)."""
+    return Config(video=Config(enabled=False)).extend(env_config)

@@ -13,7 +13,6 @@ warmup boundary.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -22,12 +21,12 @@ import numpy as np
 
 from surreal_tpu.envs import is_jax_env, make_env
 from surreal_tpu.envs.jax.base import batch_step
+from surreal_tpu.launch.hooks import SessionHooks, host_metrics, training_env_config
 from surreal_tpu.launch.rollout import successor_and_termination
 from surreal_tpu.learners import build_learner
 from surreal_tpu.learners.aggregator import nstep_transitions
 from surreal_tpu.learners.ddpg import ou_noise_step
 from surreal_tpu.replay import build_replay
-from surreal_tpu.session.tracker import PeriodicTracker
 
 
 class OffPolicyCarry(NamedTuple):
@@ -45,19 +44,44 @@ TRANS_KEYS = ("obs", "next_obs", "action", "reward", "done", "terminated")
 class OffPolicyTrainer:
     def __init__(self, config):
         self.config = config
-        self.env = make_env(config.env_config)
+        self.env = make_env(training_env_config(config.env_config))
         self.learner = build_learner(config.learner_config, self.env.specs)
         algo = self.learner.config.algo
         self.algo = algo
-        self.replay = build_replay(self.learner.config.replay)
         self.horizon = algo.horizon
         self.num_envs = config.env_config.num_envs
         self.device_mode = is_jax_env(self.env)
         self.seed = config.session_config.seed
         self.prioritized = self.learner.config.replay.kind == "prioritized"
+        self.mesh = None
         if self.device_mode:
-            self._train_iter = jax.jit(self._device_train_iter)
+            from surreal_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(config.session_config.topology)
+            if self.mesh.size > 1:
+                # dp over the mesh: per-device replay shards (the
+                # reference's ShardedReplay role, replay/sharded.py) +
+                # gradient pmean inside learner.learn
+                from surreal_tpu.parallel.dp import dp_offpolicy_iter
+                from surreal_tpu.replay.sharded import scale_replay_config
+
+                dp = self.mesh.shape["dp"]
+                if self.num_envs % dp != 0:
+                    raise ValueError(
+                        f"num_envs={self.num_envs} must be divisible by the "
+                        f"dp axis size {dp}"
+                    )
+                self.replay = build_replay(
+                    scale_replay_config(self.learner.config.replay, dp)
+                )
+                self._train_iter = dp_offpolicy_iter(
+                    self._device_train_iter, self.mesh
+                )
+            else:
+                self.replay = build_replay(self.learner.config.replay)
+                self._train_iter = jax.jit(self._device_train_iter)
         else:
+            self.replay = build_replay(self.learner.config.replay)
             self._act = jax.jit(self.learner.act, static_argnames="mode")
             self._learn = jax.jit(self.learner.learn)
             self._insert = jax.jit(self.replay.insert)
@@ -117,7 +141,9 @@ class OffPolicyTrainer:
         keys = jax.random.split(key, self.horizon)
         return jax.lax.scan(step, carry, keys)
 
-    def _device_train_iter(self, state, replay_state, carry, key, beta, warmup):
+    def _device_train_iter(
+        self, state, replay_state, carry, key, beta, warmup, axis_name=None
+    ):
         rkey, ukey = jax.random.split(key)
         carry, traj = self._rollout(state, carry, rkey, warmup)
         chunk = {k: traj[k] for k in TRANS_KEYS}
@@ -137,7 +163,7 @@ class OffPolicyTrainer:
         trans = nstep_transitions(full, self.algo.gamma, n)
         replay_state = self.replay.insert(replay_state, trans)
         # obs-normalizer: fold each fresh obs exactly once per chunk
-        state = self.learner.update_obs_stats(state, chunk["obs"])
+        state = self.learner.update_obs_stats(state, chunk["obs"], axis_name)
 
         def run_updates(operand):
             state, replay_state = operand
@@ -153,7 +179,9 @@ class OffPolicyTrainer:
                     replay_state, batch, info = self.replay.sample(
                         replay_state, update_key
                     )
-                state, metrics = self.learner.learn(state, batch, update_key)
+                state, metrics = self.learner.learn(
+                    state, batch, update_key, axis_name
+                )
                 td_abs = metrics.pop("priority/td_abs")
                 if self.prioritized:
                     replay_state = self.replay.update_priorities(
@@ -184,9 +212,20 @@ class OffPolicyTrainer:
             skip_updates,
             (state, replay_state),
         )
+        if axis_name is not None and self.prioritized:
+            # max_priority diverges across shards (each sees its own TDs);
+            # pmax keeps the fresh-insert priority scale global, and keeps
+            # the scalar genuinely replicated for the shard_map out spec
+            replay_state = replay_state._replace(
+                max_priority=jax.lax.pmax(replay_state.max_priority, axis_name)
+            )
         n_done = traj["ep_done"].sum()
+        ep_return_sum = traj["ep_return"].sum()
+        if axis_name is not None:
+            n_done = jax.lax.psum(n_done, axis_name)
+            ep_return_sum = jax.lax.psum(ep_return_sum, axis_name)
         metrics["episode/return"] = jnp.where(
-            n_done > 0, traj["ep_return"].sum() / jnp.maximum(n_done, 1), jnp.nan
+            n_done > 0, ep_return_sum / jnp.maximum(n_done, 1), jnp.nan
         )
         metrics["episode/count"] = n_done.astype(jnp.float32)
         return state, replay_state, carry, metrics
@@ -200,19 +239,25 @@ class OffPolicyTrainer:
         cfg = self.config.session_config
         total = max_env_steps or cfg.total_env_steps
         steps_per_iter = self.horizon * self.num_envs
-        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
         act_dim = int(self.env.specs.action.shape[0])
 
         key = jax.random.key(self.seed)
         key, init_key, env_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
+        hooks = SessionHooks(self.config, self.learner)
+        try:
+            state, iteration, env_steps = hooks.restore(state)
+            hooks.begin_run(iteration, env_steps)
+            if not self.device_mode:
+                return self._run_host(
+                    total, on_metrics, hooks, state, iteration, env_steps
+                )
+            if self.mesh is not None and self.mesh.size > 1:
+                from jax.sharding import NamedSharding, PartitionSpec
 
-        iteration = 0
-        env_steps = 0
-        last_metrics: dict = {}
-        t0 = time.time()
-
-        if self.device_mode:
+                state = jax.device_put(
+                    state, NamedSharding(self.mesh, PartitionSpec())
+                )
             keys = jax.random.split(env_key, self.num_envs)
             env_state, obs = jax.vmap(self.env.reset)(keys)
             n = self.algo.n_step
@@ -249,9 +294,14 @@ class OffPolicyTrainer:
                     "discount": jnp.zeros((1, 1), jnp.float32),
                 },
             )
-            replay_state = self.replay.init(example)
+            if self.mesh is not None and self.mesh.size > 1:
+                from surreal_tpu.replay.sharded import sharded_replay_init
+
+                replay_state = sharded_replay_init(self.replay, example, self.mesh)
+            else:
+                replay_state = self.replay.init(example)
             while env_steps < total:
-                key, it_key = jax.random.split(key)
+                key, it_key, hk_key = jax.random.split(key, 3)
                 beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
                 warmup = jnp.asarray(
                     env_steps < self.algo.exploration.warmup_steps
@@ -261,17 +311,15 @@ class OffPolicyTrainer:
                 )
                 iteration += 1
                 env_steps += steps_per_iter
-                if metrics_every.track_increment():
-                    m = {k: float(v) for k, v in metrics.items()}
-                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
-                    m["time/env_steps"] = env_steps
-                    last_metrics = m
-                    if on_metrics and on_metrics(iteration, m):
-                        break
-        else:
-            state, last_metrics = self._run_host(total, on_metrics, t0)
-
-        return state, last_metrics
+                _, stop = hooks.end_iteration(
+                    iteration, env_steps, state, hk_key, metrics, on_metrics
+                )
+                if stop:
+                    break
+            hooks.final_checkpoint(iteration, env_steps, state)
+            return state, hooks.last_metrics
+        finally:
+            hooks.close()
 
     def _beta(self, env_steps: int, total: int) -> float:
         """Prioritized IS beta anneal beta0 -> 1.0 over training."""
@@ -282,15 +330,11 @@ class OffPolicyTrainer:
         return b0 + (1.0 - b0) * frac
 
     # -- host path -----------------------------------------------------------
-    def _run_host(self, total, on_metrics, t0):
-        cfg = self.config.session_config
+    def _run_host(self, total, on_metrics, hooks, state, iteration, env_steps):
         steps_per_iter = self.horizon * self.num_envs
-        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
         act_dim = int(self.env.specs.action.shape[0])
 
         key = jax.random.key(self.seed + 1)
-        key, init_key = jax.random.split(key)
-        state = self.learner.init(init_key)
         obs = self.env.reset(seed=self.config.env_config.seed)
         example = {
             "obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
@@ -317,9 +361,6 @@ class OffPolicyTrainer:
         else:
             host_tail = None
 
-        env_steps = 0
-        iteration = 0
-        last_metrics: dict = {}
         recent_returns: list = []
         while env_steps < total:
             steps = []
@@ -388,13 +429,12 @@ class OffPolicyTrainer:
                 metrics = {}
             iteration += 1
             env_steps += steps_per_iter
-            if metrics_every.track_increment():
-                m = {k: float(v) for k, v in metrics.items()}
-                if recent_returns:
-                    m["episode/return"] = float(np.mean(recent_returns[-20:]))
-                m["time/env_steps"] = env_steps
-                m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
-                last_metrics = m
-                if on_metrics and on_metrics(iteration, m):
-                    break
-        return state, last_metrics
+            key, hk_key = jax.random.split(key)
+            _, stop = hooks.end_iteration(
+                iteration, env_steps, state, hk_key,
+                host_metrics(metrics, recent_returns), on_metrics,
+            )
+            if stop:
+                break
+        hooks.final_checkpoint(iteration, env_steps, state)
+        return state, hooks.last_metrics

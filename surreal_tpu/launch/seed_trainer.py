@@ -9,15 +9,22 @@ Data flow:
         one-update staleness; works for IMPALA and, with staleness caveats,
         PPO)
 
-Workers default to threads (fine for gym classic-control; MuJoCo-heavy
-envs should use ``worker_mode='process'``).
+Workers run as threads (fine for gym classic-control) or OS processes
+(``worker_mode='process'`` — MuJoCo-heavy stepping releases the GIL
+poorly, so real deployments fork the reference's actor-pool way; both
+modes run the same ``run_env_worker``).
+
+Staleness: every transition carries the params version that chose its
+action (InferenceServer tags them; SURVEY.md §7 hard-parts). V-trace
+(IMPALA) absorbs bounded staleness by construction; for PPO-over-SEED set
+``max_staleness`` to drop chunks whose oldest transition was acted more
+than that many updates ago instead of silently training on them.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-import time
 from typing import Callable
 
 import jax
@@ -26,11 +33,17 @@ import numpy as np
 from surreal_tpu.distributed.env_worker import run_env_worker
 from surreal_tpu.distributed.inference_server import InferenceServer
 from surreal_tpu.learners import build_learner
-from surreal_tpu.session.tracker import PeriodicTracker
 
 
 class SEEDTrainer:
-    def __init__(self, config, worker_mode: str = "thread"):
+    def __init__(
+        self,
+        config,
+        worker_mode: str = "thread",
+        max_staleness: int | None = None,
+    ):
+        if worker_mode not in ("thread", "process"):
+            raise ValueError(f"worker_mode {worker_mode!r} not in thread|process")
         self.config = config
         from surreal_tpu.envs import make_env
 
@@ -42,9 +55,42 @@ class SEEDTrainer:
         self.algo = self.learner.config.algo
         self.num_workers = max(1, config.session_config.topology.num_env_workers)
         self.worker_mode = worker_mode
+        self.max_staleness = max_staleness
 
         self._jit_act = jax.jit(self.learner.act, static_argnames="mode")
         self._learn = jax.jit(self.learner.learn)
+
+    def _spawn_workers(self, env_cfg, address, stop):
+        """Start env workers as threads or subprocesses; returns the list.
+
+        Process mode uses the ``spawn`` start method: forking after jax/zmq
+        have started threads is unsafe, and workers only need numpy + the
+        host env anyway.
+        """
+        workers = []
+        if self.worker_mode == "process":
+            import multiprocessing as mp
+
+            ctx = mp.get_context("spawn")
+            for i in range(self.num_workers):
+                p = ctx.Process(
+                    target=run_env_worker,
+                    args=(env_cfg.to_dict(), address, i),
+                    daemon=True,
+                )
+                p.start()
+                workers.append(p)
+        else:
+            for i in range(self.num_workers):
+                t = threading.Thread(
+                    target=run_env_worker,
+                    args=(env_cfg, address, i),
+                    kwargs={"stop_event": stop},
+                    daemon=True,
+                )
+                t.start()
+                workers.append(t)
+        return workers
 
     def _make_act_fn(self, state, key_holder):
         def act_fn(obs_np):
@@ -61,54 +107,64 @@ class SEEDTrainer:
     ):
         cfg = self.config.session_config
         total = max_env_steps or cfg.total_env_steps
-        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
 
         key = jax.random.key(cfg.seed)
         key, init_key, act_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
-        key_holder = [act_key]
+        from surreal_tpu.launch.hooks import SessionHooks, training_env_config
 
-        server = InferenceServer(
-            act_fn=self._make_act_fn(state, key_holder),
-            unroll_length=self.algo.horizon,
-        )
+        hooks = SessionHooks(self.config, self.learner)
+        server = None
+        workers: list = []
         stop = threading.Event()
-        workers = []
-        env_cfg = self.config.env_config
-        for i in range(self.num_workers):
-            t = threading.Thread(
-                target=run_env_worker,
-                args=(env_cfg, server.address, i),
-                kwargs={"stop_event": stop},
-                daemon=True,
-            )
-            t.start()
-            workers.append(t)
-
-        env_steps = 0
-        iteration = 0
-        last_metrics: dict = {}
-        t0 = time.time()
         try:
+            state, iteration, env_steps = hooks.restore(state)
+            hooks.begin_run(iteration, env_steps)
+            key_holder = [act_key]
+            server = InferenceServer(
+                act_fn=self._make_act_fn(state, key_holder),
+                unroll_length=self.algo.horizon,
+            )
+            env_cfg = training_env_config(self.config.env_config)
+            workers = self._spawn_workers(env_cfg, server.address, stop)
+
+            dropped_stale = 0
             while env_steps < total:
                 try:
                     chunk = server.chunks.get(timeout=30)
                 except queue.Empty:
                     raise TimeoutError("no experience chunks arriving from workers")
+                versions = chunk.pop("param_version")
+                staleness = server.version - int(versions.min())
+                if self.max_staleness is not None and staleness > self.max_staleness:
+                    dropped_stale += 1
+                    continue  # acted by a too-old policy: drop, don't train
                 batch = jax.device_put(chunk)
-                key, lkey = jax.random.split(key)
+                key, lkey, hk_key = jax.random.split(key, 3)
                 state, metrics = self._learn(state, batch, lkey)
                 server.set_act_fn(self._make_act_fn(state, key_holder))
                 iteration += 1
                 env_steps += chunk["reward"].shape[0] * chunk["reward"].shape[1]
-                if metrics_every.track_increment():
-                    m = {k: float(v) for k, v in metrics.items()}
-                    m["time/env_steps"] = env_steps
-                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
-                    last_metrics = m
-                    if on_metrics and on_metrics(iteration, m):
-                        break
+                metrics = dict(
+                    metrics,
+                    **{
+                        "staleness/updates_behind": float(staleness),
+                        "staleness/dropped_chunks": float(dropped_stale),
+                    },
+                )
+                _, stop_flag = hooks.end_iteration(
+                    iteration, env_steps, state, hk_key, metrics, on_metrics
+                )
+                if stop_flag:
+                    break
+            hooks.final_checkpoint(iteration, env_steps, state)
+            return state, hooks.last_metrics
         finally:
             stop.set()
-            server.close()
-        return state, last_metrics
+            if server is not None:
+                server.close()
+            for w in workers:
+                if hasattr(w, "terminate"):  # subprocess workers
+                    w.terminate()
+                    w.join(timeout=5)
+            hooks.close()

@@ -16,7 +16,6 @@ Two drive modes, chosen by the env family:
 
 from __future__ import annotations
 
-import time
 from functools import partial
 from typing import Callable
 
@@ -25,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from surreal_tpu.envs import is_jax_env, make_env
+from surreal_tpu.launch.hooks import SessionHooks, host_metrics, training_env_config
 from surreal_tpu.launch.rollout import (
     RolloutCarry,
     device_rollout,
@@ -32,7 +32,6 @@ from surreal_tpu.launch.rollout import (
     init_device_carry,
 )
 from surreal_tpu.learners import build_learner
-from surreal_tpu.session.tracker import PeriodicTracker
 
 
 class Trainer:
@@ -41,7 +40,7 @@ class Trainer:
 
     def __init__(self, config):
         self.config = config
-        self.env = make_env(config.env_config)
+        self.env = make_env(training_env_config(config.env_config))
         self.learner = build_learner(config.learner_config, self.env.specs)
         # the learner holds the fully-extended tree (algo defaults applied)
         self.horizon = self.learner.config.algo.horizon
@@ -120,53 +119,54 @@ class Trainer:
         cfg = self.config.session_config
         total = max_env_steps or cfg.total_env_steps
         steps_per_iter = self.horizon * self.num_envs
-        metrics_every = PeriodicTracker(cfg.metrics.every_n_iters)
 
         key = jax.random.key(self.seed)
         key, init_key, env_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
+        hooks = SessionHooks(self.config, self.learner)
+        try:
+            state, iteration, env_steps = hooks.restore(state)
+            if self.mesh is not None and self.mesh.size > 1:
+                # restored checkpoints come back committed to one device;
+                # the dp shard_map needs the state replicated over the mesh
+                from jax.sharding import NamedSharding, PartitionSpec
 
-        last_metrics: dict = {}
-        iteration = 0
-        env_steps = 0
-        t0 = time.time()
-
-        if self.device_mode:
-            carry = init_device_carry(self.env, env_key, self.num_envs)
-            while env_steps < total:
-                key, it_key = jax.random.split(key)
-                state, carry, metrics = self._train_iter(state, carry, it_key)
-                iteration += 1
-                env_steps += steps_per_iter
-                if metrics_every.track_increment():
-                    m = {k: float(v) for k, v in metrics.items()}
-                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
-                    m["time/env_steps"] = env_steps
-                    last_metrics = m
-                    if on_metrics and on_metrics(iteration, m):
-                        break
-        else:
-            obs = self.env.reset(seed=self.config.env_config.seed)
-            recent_returns = []
-            while env_steps < total:
-                key, r_key, l_key = jax.random.split(key, 3)
-                obs, batch, ep_stats = host_rollout(
-                    self.env, self._act, state, obs, r_key, self.horizon
+                state = jax.device_put(
+                    state, NamedSharding(self.mesh, PartitionSpec())
                 )
-                state, metrics = self._learn(state, batch, l_key)
-                iteration += 1
-                env_steps += steps_per_iter
-                recent_returns.extend(ep_stats["returns"])
-                if metrics_every.track_increment():
-                    m = {k: float(v) for k, v in metrics.items()}
-                    if recent_returns:
-                        m["episode/return"] = float(
-                            np.mean(recent_returns[-20:])
-                        )
-                    m["time/env_steps_per_s"] = env_steps / (time.time() - t0)
-                    m["time/env_steps"] = env_steps
-                    last_metrics = m
-                    if on_metrics and on_metrics(iteration, m):
-                        break
+            hooks.begin_run(iteration, env_steps)
 
-        return state, last_metrics
+            if self.device_mode:
+                carry = init_device_carry(self.env, env_key, self.num_envs)
+                while env_steps < total:
+                    key, it_key, hk_key = jax.random.split(key, 3)
+                    state, carry, metrics = self._train_iter(state, carry, it_key)
+                    iteration += 1
+                    env_steps += steps_per_iter
+                    _, stop = hooks.end_iteration(
+                        iteration, env_steps, state, hk_key, metrics, on_metrics
+                    )
+                    if stop:
+                        break
+            else:
+                obs = self.env.reset(seed=self.config.env_config.seed)
+                recent_returns = []
+                while env_steps < total:
+                    key, r_key, l_key, hk_key = jax.random.split(key, 4)
+                    obs, batch, ep_stats = host_rollout(
+                        self.env, self._act, state, obs, r_key, self.horizon
+                    )
+                    state, metrics = self._learn(state, batch, l_key)
+                    iteration += 1
+                    env_steps += steps_per_iter
+                    recent_returns.extend(ep_stats["returns"])
+                    _, stop = hooks.end_iteration(
+                        iteration, env_steps, state, hk_key,
+                        host_metrics(metrics, recent_returns), on_metrics,
+                    )
+                    if stop:
+                        break
+            hooks.final_checkpoint(iteration, env_steps, state)
+            return state, hooks.last_metrics
+        finally:
+            hooks.close()

@@ -1,0 +1,5 @@
+"""Entry points (parity: reference ``surreal/main/``, SURVEY.md §2.1)."""
+
+from surreal_tpu.main.launch import build_config, main, select_trainer
+
+__all__ = ["build_config", "main", "select_trainer"]

@@ -1,0 +1,176 @@
+"""Experiment launcher — L7 entry point (parity: reference
+``surreal/main/launch.py`` ``SurrealDefaultLauncher`` + the
+``surreal-tmux``/``surreal-subproc``/``surreal-kube`` cluster CLIs,
+SURVEY.md §2.1 Main-dispatch/Cluster-CLI rows and §3.1).
+
+The reference CLI built a symphony process group — agents, learner,
+replay(-shards), ps, evals, tensorplex, loggerplex, tensorboard — and
+launched one OS process per component. In the TPU rebuild those components
+are modules of ONE SPMD program, so the launcher's job collapses to:
+
+    parse (algo, env, overrides) -> three config trees -> pick the driver
+    -> run with checkpoint + metrics + eval wired (SessionHooks).
+
+Component-role map (for auditability against the reference dispatch):
+    run_agent / run_agent-batch -> rollout collectors inside the driver
+                                   (launch/rollout.py, SEED inference server)
+    run_learner                 -> learner step inside the driver
+    run_replay                  -> HBM replay (replay/) inside the driver
+    run_ps                      -> device-resident params (no process); host
+                                   plane: distributed/param_service.py
+    run_eval(s)                 -> launch/evaluator.py via SessionHooks
+    run_tensorboard/tensorplex/loggerplex -> session/metrics.py writers
+    tmux/kube/subproc cluster   -> session_config.topology (mesh axes +
+                                   env-worker processes), no external CLI
+
+Usage:
+    python -m surreal_tpu train ppo jax:lift --folder /tmp/exp1
+    python -m surreal_tpu train ddpg jax:lift --folder /tmp/exp2 \
+        --num-envs 256 --set learner_config.algo.n_step=3
+    python -m surreal_tpu eval --folder /tmp/exp1 --episodes 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+
+ALGOS = ("ppo", "ddpg", "impala")
+
+
+def build_config(args) -> Config:
+    """CLI args -> fully-extended three-tree config bundle."""
+    overrides = Config(
+        learner_config=Config(algo=Config(name=args.algo)),
+        env_config=Config(name=args.env, num_envs=args.num_envs),
+        session_config=Config(folder=args.folder),
+    )
+    if args.total_steps is not None:
+        overrides.session_config.total_env_steps = args.total_steps
+    if args.restore_from is not None:
+        overrides.session_config.checkpoint = Config(restore_from=args.restore_from)
+    if args.set:
+        overrides.override_from_dotlist(args.set)
+    return overrides.extend(base_config())
+
+
+def select_trainer(config):
+    """Map config -> driver (the component-dispatch role of the reference's
+    launcher, collapsed to one decision):
+
+    - off-policy algos (ddpg) -> OffPolicyTrainer (replay-driven)
+    - host envs with env workers configured -> SEEDTrainer (batched
+      inference server + worker processes/threads)
+    - everything else -> Trainer (fused device loop, or host alternation)
+    """
+    algo = config.learner_config.algo.name
+    env_name = config.env_config.name
+    workers = config.session_config.topology.num_env_workers
+    if algo == "ddpg":
+        from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+        return OffPolicyTrainer(config)
+    if not env_name.startswith("jax:") and workers > 0:
+        from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+        return SEEDTrainer(config)
+    from surreal_tpu.launch.trainer import Trainer
+
+    return Trainer(config)
+
+
+def run_train(args) -> int:
+    config = build_config(args)
+    os.makedirs(config.session_config.folder, exist_ok=True)
+    # persist the resolved config so `eval` (and future resumes) can rebuild
+    # the exact learner/env without re-supplying CLI flags
+    with open(os.path.join(config.session_config.folder, "config.json"), "w") as f:
+        f.write(config.dumps())
+    trainer = select_trainer(config)
+    state, metrics = trainer.run()
+    print(json.dumps({k: v for k, v in sorted(metrics.items())}, default=float))
+    return 0
+
+
+def run_eval(args) -> int:
+    """Score a trained session folder (reference ``run_eval`` as a CLI)."""
+    import jax
+
+    from surreal_tpu.envs import make_env
+    from surreal_tpu.launch.evaluator import Evaluator
+    from surreal_tpu.learners import build_learner
+    from surreal_tpu.session.checkpoint import CheckpointManager
+
+    cfg_path = os.path.join(args.folder, "config.json")
+    if not os.path.exists(cfg_path):
+        print(f"no config.json under {args.folder!r} (was it trained via the CLI?)",
+              file=sys.stderr)
+        return 2
+    with open(cfg_path) as f:
+        config = Config(json.load(f))
+    probe = make_env(config.env_config)
+    learner = build_learner(config.learner_config, probe.specs)
+    if hasattr(probe, "close"):
+        probe.close()
+
+    mgr = CheckpointManager(config.session_config.folder)
+    template = learner.init(jax.random.key(0))
+    restored = (
+        mgr.restore_best(template) if args.best else mgr.restore(template)
+    )
+    if restored is None:
+        print(f"no {'best ' if args.best else ''}checkpoint under {args.folder!r}",
+              file=sys.stderr)
+        mgr.close()
+        return 2
+    state, meta = restored
+    mgr.close()
+
+    eval_cfg = Config(episodes=args.episodes, mode=args.mode)
+    ev = Evaluator(config.env_config, eval_cfg, learner)
+    out = ev.evaluate(state, jax.random.key(args.seed))
+    ev.close()
+    out["checkpoint/iteration"] = meta["iteration"]
+    out["checkpoint/env_steps"] = meta["env_steps"]
+    print(json.dumps({k: v for k, v in sorted(out.items())}, default=float))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="surreal_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="launch a training experiment")
+    t.add_argument("algo", choices=ALGOS)
+    t.add_argument("env", help="env name with backend prefix, e.g. jax:lift, "
+                   "gym:CartPole-v1, dm_control:cheetah-run")
+    t.add_argument("--folder", required=True, help="session/experiment directory")
+    t.add_argument("--num-envs", type=int, default=64)
+    t.add_argument("--total-steps", type=int, default=None)
+    t.add_argument("--restore-from", default=None,
+                   help="foreign session folder to warm-start from")
+    t.add_argument("--set", nargs="*", metavar="KEY=VAL", default=[],
+                   help="dotlist overrides, e.g. learner_config.algo.horizon=64")
+    t.set_defaults(fn=run_train)
+
+    e = sub.add_parser("eval", help="evaluate a trained session folder")
+    e.add_argument("--folder", required=True)
+    e.add_argument("--episodes", type=int, default=10)
+    e.add_argument("--mode", choices=("deterministic", "stochastic"),
+                   default="deterministic")
+    e.add_argument("--best", action="store_true",
+                   help="use the keep-best checkpoint instead of the latest")
+    e.add_argument("--seed", type=int, default=0)
+    e.set_defaults(fn=run_eval)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

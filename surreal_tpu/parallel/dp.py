@@ -55,6 +55,61 @@ def _spec_like_metrics(spec: P):
     return spec
 
 
+def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
+    """Shard the fused off-policy iteration
+    ``(state, replay_state, carry, key, beta, warmup) -> (state,
+    replay_state, carry, metrics)`` over the mesh: learner state replicated,
+    replay state per-device shards (storage sharded, lockstep scalars
+    replicated — see replay/sharded.py), carry sharded on the env-batch dim
+    (the n-step ``tail`` is time-major, so its shard dim is 1).
+
+    ``trainer_iter`` must accept ``axis_name`` (kw) and thread it to
+    ``learner.learn`` + psum its episode/priority bookkeeping.
+    """
+    from surreal_tpu.replay.sharded import replay_state_specs
+
+    def sharded_iter(state, replay_state, carry, key, beta, warmup):
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        return trainer_iter(
+            state, replay_state, carry, key, beta, warmup, axis_name=axis
+        )
+
+    def carry_specs(carry):
+        # OffPolicyCarry: every field is [B, ...] except tail {k: [T, B, ...]}
+        return type(carry)(
+            env_state=_spec_like(carry.env_state, P(axis)),
+            obs=P(axis),
+            noise=P(axis),
+            ep_return=P(axis),
+            ep_length=P(axis),
+            tail=None if carry.tail is None else _spec_like(carry.tail, P(None, axis)),
+        )
+
+    def wrapped(state, replay_state, carry, key, beta, warmup):
+        shard = shard_map(
+            sharded_iter,
+            mesh=mesh,
+            in_specs=(
+                _spec_like(state, P()),
+                replay_state_specs(replay_state, axis),
+                carry_specs(carry),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=(
+                _spec_like(state, P()),
+                replay_state_specs(replay_state, axis),
+                carry_specs(carry),
+                _spec_like_metrics(P()),
+            ),
+            check_vma=False,
+        )
+        return shard(state, replay_state, carry, key, beta, warmup)
+
+    return jax.jit(wrapped)
+
+
 def dp_train_iter(trainer_iter, learner: Learner, mesh: Mesh, axis: str = "dp"):
     """Shard a fused rollout+learn ``train_iter(state, carry, key)`` over
     the mesh: learner state replicated, rollout carry (env states, obs,

@@ -42,6 +42,61 @@ def build_replay(replay_config):
     raise ValueError(f"unknown replay kind {kind!r}; have fifo | uniform | prioritized")
 
 
+def scale_replay_config(replay_config, dp: int):
+    """Per-device replay config for a dp-way sharded buffer: capacity /
+    batch / warmup threshold divide across shards (the global semantics —
+    total capacity, total SGD batch via gradient pmean — are unchanged)."""
+    from surreal_tpu.session.config import Config
+
+    for field in ("capacity", "batch_size", "start_sample_size"):
+        if replay_config[field] % dp != 0:
+            raise ValueError(
+                f"replay.{field}={replay_config[field]} must be divisible by "
+                f"the dp axis size {dp}"
+            )
+    return Config(
+        capacity=replay_config.capacity // dp,
+        batch_size=replay_config.batch_size // dp,
+        start_sample_size=replay_config.start_sample_size // dp,
+    ).extend(replay_config)
+
+
+def sharded_replay_init(replay, example: Any, mesh: Mesh, axis: str = "dp") -> Any:
+    """Allocate one independent buffer shard per device (``replay`` must be
+    built with the per-device scaled config).
+
+    Storage-like leaves (rank >= 1, leading dim = local capacity) shard on
+    the dp axis; the scalar bookkeeping (cursor/size/max_priority) is
+    replicated — valid because every shard inserts and samples identical
+    COUNTS in lockstep, so cursors never diverge, and the one
+    insert-divergent scalar (prioritized max_priority) is re-synced with a
+    pmax inside the training step (see OffPolicyTrainer._device_train_iter).
+    """
+    from jax import shard_map
+
+    local = jax.eval_shape(replay.init, example)
+    out_specs = jax.tree.map(
+        lambda l: P(axis) if len(l.shape) >= 1 else P(), local
+    )
+    fn = shard_map(
+        lambda: replay.init(example),
+        mesh=mesh,
+        in_specs=(),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return jax.jit(fn)()
+
+
+def replay_state_specs(state_or_shapes: Any, axis: str = "dp") -> Any:
+    """PartitionSpec tree for a sharded replay state (leaf rank rule as
+    above) — used as shard_map in/out specs by the dp off-policy wrapper."""
+    return jax.tree.map(
+        lambda l: P(axis) if getattr(l, "ndim", len(getattr(l, "shape", ()))) >= 1 else P(),
+        state_or_shapes,
+    )
+
+
 def shard_replay_state(state: Any, mesh: Mesh, axis: str = "dp") -> Any:
     """Place a replicated-constructed replay state as per-device shards:
     storage leaves shard on their leading (capacity/slot) dim, scalars

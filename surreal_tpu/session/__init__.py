@@ -1,0 +1,25 @@
+"""Session layer (parity: reference ``surreal/session/`` + observability
+deps, SURVEY.md §2.1 / §5.4-5.6): config trees, trackers, checkpointing,
+metrics/logging."""
+
+from surreal_tpu.session.config import REQUIRED, Config
+from surreal_tpu.session.checkpoint import CheckpointManager, make_checkpoint_manager
+from surreal_tpu.session.metrics import MetricsWriter, get_logger, make_metrics_writer
+from surreal_tpu.session.tracker import (
+    MetricAggregator,
+    PeriodicTimeTracker,
+    PeriodicTracker,
+)
+
+__all__ = [
+    "REQUIRED",
+    "Config",
+    "CheckpointManager",
+    "make_checkpoint_manager",
+    "MetricsWriter",
+    "get_logger",
+    "make_metrics_writer",
+    "MetricAggregator",
+    "PeriodicTimeTracker",
+    "PeriodicTracker",
+]

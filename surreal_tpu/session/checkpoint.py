@@ -1,0 +1,145 @@
+"""Checkpoint/resume (parity: reference ``surreal/utils/checkpoint.py`` —
+``PeriodicCheckpoint`` with keep-last-N / keep-best retention and a
+``restore_folder`` path through learner setup; SURVEY.md §2.1 Checkpoint
+row and §5.4), built on orbax.
+
+What is checkpointed: the **learner state pytree** (params, optimizer
+state, obs-normalizer stats, adaptive scalars) plus run metadata
+(iteration, env_steps). Environment/rollout carries are NOT checkpointed —
+on resume, envs reset and refill, exactly as the reference's actors
+restarted stateless and re-fetched parameters (SURVEY.md §5.3/§5.4
+"agents don't checkpoint"). That makes resume trivially correct for both
+the on-policy fused path and the replay path (the replay warms back up
+past ``start_sample_size`` before learning resumes).
+
+Layout under ``<session folder>/checkpoints/``:
+    <step>/            orbax step dirs, pruned to ``keep_last``
+    best/              overwritten copy of the best-metric state (keep_best)
+    best_metric.json   the best metric value + the step it came from
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    """Save/restore learner state with keep-last-N + keep-best retention."""
+
+    def __init__(
+        self,
+        folder: str,
+        keep_last: int = 3,
+        keep_best: bool = True,
+        best_key: str = "episode/return",
+    ):
+        self.directory = os.path.join(os.path.abspath(folder), "checkpoints")
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep_best = keep_best
+        self.best_key = best_key
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep_last,
+                create=True,
+                # best/ is handled by hand below so keep-last and keep-best
+                # retention compose instead of competing in one policy
+            ),
+        )
+        self._best_dir = os.path.join(self.directory, "best")
+        self._best_meta_path = os.path.join(self.directory, "best_metric.json")
+        self._best_ckptr = ocp.StandardCheckpointer()
+
+    # -- save ----------------------------------------------------------------
+    def save(
+        self,
+        step: int,
+        state: Any,
+        *,
+        env_steps: int = 0,
+        metrics: dict[str, float] | None = None,
+    ) -> None:
+        """Persist ``state`` at ``step``; update best/ when the tracked
+        metric improves."""
+        payload = {
+            "state": state,
+            "meta": {"iteration": step, "env_steps": env_steps},
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        self._mgr.wait_until_finished()
+
+        if not (self.keep_best and metrics):
+            return
+        value = metrics.get(self.best_key)
+        if value is None or value != value:  # absent or NaN
+            return
+        best = self.best_metric()
+        if best is not None and value <= best["value"]:
+            return
+        # orbax's own tmp-dir + rename makes the overwrite atomic
+        self._best_ckptr.save(self._best_dir, payload, force=True)
+        self._best_ckptr.wait_until_finished()
+        with open(self._best_meta_path, "w") as f:
+            json.dump({"value": float(value), "step": int(step)}, f)
+
+    # -- restore -------------------------------------------------------------
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def best_metric(self) -> dict | None:
+        if not os.path.exists(self._best_meta_path):
+            return None
+        with open(self._best_meta_path) as f:
+            return json.load(f)
+
+    def restore(self, template_state: Any, step: int | None = None):
+        """Restore (state, meta) at ``step`` (default latest).
+
+        ``template_state`` supplies the pytree structure/shardings to
+        restore into — call sites pass a freshly ``init()``-ed state.
+        Returns None when no checkpoint exists.
+        """
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        template = {
+            "state": template_state,
+            "meta": {"iteration": 0, "env_steps": 0},
+        }
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        payload = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        return payload["state"], payload["meta"]
+
+    def restore_best(self, template_state: Any):
+        """Restore the keep-best snapshot; None when absent."""
+        if self.best_metric() is None:
+            return None
+        template = {
+            "state": template_state,
+            "meta": {"iteration": 0, "env_steps": 0},
+        }
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        payload = self._best_ckptr.restore(self._best_dir, abstract)
+        return payload["state"], payload["meta"]
+
+    def close(self) -> None:
+        self._mgr.close()
+        self._best_ckptr.close()
+
+
+def make_checkpoint_manager(session_config) -> CheckpointManager | None:
+    """Build from ``session_config.checkpoint``; None when disabled
+    (``every_n_iters`` <= 0)."""
+    ck = session_config.checkpoint
+    if not ck.every_n_iters or ck.every_n_iters <= 0:
+        return None
+    return CheckpointManager(
+        session_config.folder,
+        keep_last=ck.keep_last,
+        keep_best=ck.keep_best,
+    )

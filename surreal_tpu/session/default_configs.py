@@ -83,7 +83,8 @@ BASE_SESSION_CONFIG = Config(
         every_n_iters=500,
         keep_last=3,
         keep_best=True,
-        restore_from=None,  # folder to resume from
+        restore_from=None,   # foreign session folder to warm-start from
+        auto_resume=True,    # resume from own folder's latest checkpoint
     ),
     metrics=Config(
         every_n_iters=10,
@@ -94,6 +95,11 @@ BASE_SESSION_CONFIG = Config(
         every_n_iters=100,
         episodes=5,
         mode="deterministic",  # 'deterministic' | 'stochastic'
+    ),
+    profiler=Config(
+        enabled=False,     # jax.profiler trace window (SURVEY.md §5.1)
+        start_iter=20,     # after compile + warmup
+        num_iters=5,
     ),
     seed=0,
 )

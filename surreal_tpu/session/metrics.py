@@ -1,0 +1,150 @@
+"""Metrics writer (parity: the reference's tensorplex + loggerplex +
+tensorboard trio, SURVEY.md §5.5 and §2.2).
+
+The reference ran three separate observability *processes*: tensorplex
+(scalar aggregation across workers), loggerplex (remote text logs) and a
+tensorboard server, wired over ZMQ. The rebuild is one SPMD program, so the
+whole trio collapses into one in-process writer:
+
+- cross-worker averaging  -> :class:`~surreal_tpu.session.tracker.MetricAggregator`
+  (tensorplex's averaging groups, already local)
+- scalar event stream     -> tensorboard event files written directly
+  (``<folder>/tb/``), readable by any stock tensorboard
+- remote text logging     -> :func:`get_logger` writing console +
+  ``<folder>/logs/<name>.log``
+
+Honors ``session_config.metrics.tensorboard`` / ``.console``. The
+tensorboard backend degrades to a no-op (with one warning) if the
+``tensorboard`` package is unavailable, so headless images still train.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Mapping
+
+_TB_IMPORT_ERROR = None
+try:  # tensorboard is present in this image; guard anyway (graceful headless)
+    from tensorboard.compat.proto.event_pb2 import Event
+    from tensorboard.compat.proto.summary_pb2 import Summary
+    from tensorboard.summary.writer.event_file_writer import EventFileWriter
+except Exception as e:  # pragma: no cover - exercised only without tensorboard
+    _TB_IMPORT_ERROR = e
+
+
+class MetricsWriter:
+    """Scalar metrics sink for one experiment session.
+
+    ``write(step, metrics)`` fans each float out to the enabled backends;
+    tags keep their namespaced form (``loss/total``, ``episode/return``,
+    ``eval/return`` — the role the reference's tensorplex groups played).
+    """
+
+    def __init__(
+        self,
+        folder: str,
+        tensorboard: bool = True,
+        console: bool = True,
+        name: str = "train",
+    ):
+        self.folder = folder
+        self.console = console
+        self._tb = None
+        if tensorboard:
+            if _TB_IMPORT_ERROR is not None:
+                logging.getLogger("surreal_tpu").warning(
+                    "metrics.tensorboard=True but tensorboard is not "
+                    "importable (%s); scalar events disabled",
+                    _TB_IMPORT_ERROR,
+                )
+            else:
+                tb_dir = os.path.join(folder, "tb", name)
+                os.makedirs(tb_dir, exist_ok=True)
+                self._tb = EventFileWriter(tb_dir)
+
+    def write(self, step: int, metrics: Mapping[str, float]) -> None:
+        clean = {
+            k: float(v)
+            for k, v in metrics.items()
+            if float(v) == float(v)  # drop NaN (windows with no episodes)
+        }
+        if self._tb is not None:
+            event = Event(
+                step=int(step),
+                summary=Summary(
+                    value=[
+                        Summary.Value(tag=k, simple_value=v)
+                        for k, v in clean.items()
+                    ]
+                ),
+            )
+            event.wall_time = time.time()
+            self._tb.add_event(event)
+        if self.console:
+            parts = " ".join(f"{k}={v:.4g}" for k, v in sorted(clean.items()))
+            print(f"[{step}] {parts}", flush=True)
+
+    def flush(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.flush()
+            self._tb.close()
+            self._tb = None
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def make_metrics_writer(session_config, name: str = "train") -> MetricsWriter:
+    """Build a writer from a ``session_config`` tree (the one call sites use)."""
+    m = session_config.metrics
+    return MetricsWriter(
+        session_config.folder,
+        tensorboard=m.tensorboard,
+        console=m.console,
+        name=name,
+    )
+
+
+def get_logger(name: str, folder: str | None = None) -> logging.Logger:
+    """Structured text logging (loggerplex role): console + per-session file
+    ``<folder>/logs/<name>.log``. Idempotent per (name, folder); a call with
+    a *different* folder retargets the file handler (closing the old one)
+    so sequential sessions in one process never cross-write logs."""
+    logger = logging.getLogger(f"surreal_tpu.{name}")
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    fmt = logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s %(message)s", "%H:%M:%S"
+    )
+    have = {getattr(h, "_surreal_id", None) for h in logger.handlers}
+    if "console" not in have:
+        h = logging.StreamHandler()
+        h.setFormatter(fmt)
+        h._surreal_id = "console"
+        logger.addHandler(h)
+    if folder is not None:
+        log_dir = os.path.join(folder, "logs")
+        file_id = f"file:{log_dir}"
+        if file_id not in have:
+            for stale in [
+                h
+                for h in logger.handlers
+                if str(getattr(h, "_surreal_id", "")).startswith("file:")
+            ]:
+                logger.removeHandler(stale)
+                stale.close()
+            os.makedirs(log_dir, exist_ok=True)
+            h = logging.FileHandler(os.path.join(log_dir, f"{name}.log"))
+            h.setFormatter(fmt)
+            h._surreal_id = file_id
+            logger.addHandler(h)
+    return logger

@@ -165,14 +165,17 @@ def test_ddpg_pendulum_improves():
     cfg = Config(
         learner_config=Config(
             algo=Config(name="ddpg"),
-            replay=Config(kind="prioritized", capacity=50_000,
-                          start_sample_size=500, batch_size=128),
+            # divisible by the 8-way dp mesh the trainer now defaults to
+            replay=Config(kind="prioritized", capacity=50_048,
+                          start_sample_size=512, batch_size=128),
         ),
         env_config=Config(name="jax:pendulum", num_envs=8),
         session_config=Config(
             folder="/tmp/test_ddpg_pendulum",
             total_env_steps=100_000,
-            metrics=Config(every_n_iters=25),
+            metrics=Config(every_n_iters=25, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
         ),
     ).extend(base_config())
     trainer = OffPolicyTrainer(cfg)

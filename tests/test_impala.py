@@ -108,7 +108,9 @@ def test_impala_cartpole_256_envs_learns():
         session_config=Config(
             folder="/tmp/test_impala",
             total_env_steps=4_000_000,
-            metrics=Config(every_n_iters=20),
+            metrics=Config(every_n_iters=20, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
         ),
     ).extend(base_config())
     trainer = Trainer(cfg)

@@ -10,6 +10,7 @@ from surreal_tpu.envs.base import ArraySpec, EnvSpecs
 from surreal_tpu.learners import build_learner
 from surreal_tpu.parallel import dp_learn, make_mesh
 from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
 
 
 def topo(mesh_axes):
@@ -119,10 +120,84 @@ def test_dp_trainer_cartpole_iter_runs():
         session_config=Config(
             folder="/tmp/test_dp_trainer",
             total_env_steps=16 * 8 * 2,  # 2 iterations
-            metrics=Config(every_n_iters=1),
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
         ),
     ).extend(base_config())
     trainer = Trainer(cfg)
     assert trainer.mesh is not None and trainer.mesh.size == 8
     state, metrics = trainer.run()
     assert metrics and np.isfinite(metrics["loss/value"])
+
+
+def test_dp_offpolicy_ddpg_prioritized_sharded_replay():
+    """Multi-device DDPG (VERDICT round-1 item 6): per-device replay
+    shards, pmean'd grads, pmax'd max-priority — state must stay replicated
+    and updates must actually happen (replay past warmup)."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(
+                name="ddpg", horizon=8, updates_per_iter=2, n_step=3,
+                exploration=Config(warmup_steps=64),
+            ),
+            replay=Config(
+                kind="prioritized", capacity=4096,
+                start_sample_size=256, batch_size=128,
+            ),
+        ),
+        env_config=Config(name="jax:pendulum", num_envs=16),
+        session_config=Config(
+            folder="/tmp/test_dp_ddpg",
+            total_env_steps=16 * 8 * 20,
+            metrics=Config(every_n_iters=5, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = OffPolicyTrainer(cfg)
+    assert trainer.mesh is not None and trainer.mesh.size == 8
+    # per-device scaled replay: capacity 4096 -> 512/device etc.
+    assert trainer.replay.capacity == 512
+    assert trainer.replay.batch_size == 16
+    state0 = trainer.learner.init(jax.random.key(0))
+    state, metrics = trainer.run()
+
+    assert np.isfinite(metrics["loss/critic"])
+    assert metrics["loss/critic"] != 0.0  # updates ran (past warmup)
+    # params changed and replicas stayed bitwise identical
+    leaf0 = jax.tree.leaves(state0.actor_params)[0]
+    leaf = jax.tree.leaves(state.actor_params)[0]
+    assert not np.allclose(np.asarray(leaf), np.asarray(leaf0))
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    assert all(np.array_equal(shards[0], s) for s in shards[1:])
+
+
+def test_dp_offpolicy_matches_global_replay_semantics():
+    """The dp-scaled shards must add up to the configured global buffer:
+    inserting H*B windows per iter fills each of the 8 shards with the
+    per-device slice (H*B/8 windows)."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ddpg", horizon=4, updates_per_iter=1, n_step=1,
+                        exploration=Config(warmup_steps=10_000)),
+            replay=Config(kind="uniform", capacity=1024,
+                          start_sample_size=512, batch_size=64),
+        ),
+        env_config=Config(name="jax:pendulum", num_envs=16),
+        session_config=Config(
+            folder="/tmp/test_dp_ddpg2",
+            total_env_steps=16 * 4 * 2,  # 2 iterations, all warmup
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = OffPolicyTrainer(cfg)
+    state, metrics = trainer.run()
+    # all-warmup run: no SGD yet, losses are the cond's zero branch
+    assert metrics["loss/critic"] == 0.0

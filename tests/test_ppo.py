@@ -109,7 +109,9 @@ def test_ppo_cartpole_reaches_475():
         session_config=Config(
             folder="/tmp/test_ppo_cartpole",
             total_env_steps=600_000,
-            metrics=Config(every_n_iters=10),
+            metrics=Config(every_n_iters=10, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
         ),
     ).extend(base_config())
     trainer = Trainer(cfg)

@@ -1,0 +1,255 @@
+"""Checkpoint/resume, metrics writer, evaluator, and hooks integration
+(SURVEY.md §5.4/§5.5, §3.5; VERDICT round-1 items 2-4)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+from surreal_tpu.learners import build_learner
+from surreal_tpu.session.checkpoint import CheckpointManager
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+from surreal_tpu.session.metrics import MetricsWriter
+
+
+def _specs():
+    return EnvSpecs(
+        obs=ArraySpec(shape=(3,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(1,), dtype=np.dtype(np.float32)),
+    )
+
+
+def _params_equal(a, b) -> bool:
+    eq = jax.tree.map(lambda x, y: bool((x == y).all()), a, b)
+    return all(jax.tree.leaves(eq))
+
+
+# -- checkpoint layer -------------------------------------------------------
+
+def test_checkpoint_save_restore_roundtrip(tmp_path):
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    s0 = learner.init(jax.random.key(0))
+    cm = CheckpointManager(str(tmp_path), keep_last=2)
+    cm.save(7, s0, env_steps=123)
+    template = learner.init(jax.random.key(99))  # different init values
+    state, meta = cm.restore(template)
+    assert meta == {"iteration": 7, "env_steps": 123}
+    assert _params_equal(state.params, s0.params)
+    assert not _params_equal(template.params, s0.params)
+    cm.close()
+
+
+def test_checkpoint_keep_last_prunes_and_keep_best_tracks_max(tmp_path):
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    s = learner.init(jax.random.key(0))
+    cm = CheckpointManager(str(tmp_path), keep_last=2, keep_best=True)
+    cm.save(1, s, metrics={"episode/return": 10.0})
+    cm.save(2, s, metrics={"episode/return": 30.0})
+    cm.save(3, s, metrics={"episode/return": 20.0})
+    steps = sorted(
+        int(os.path.basename(p))
+        for p in glob.glob(str(tmp_path / "checkpoints" / "*"))
+        if os.path.basename(p).isdigit()
+    )
+    assert steps == [2, 3]  # keep_last=2 pruned step 1
+    assert cm.best_metric() == {"value": 30.0, "step": 2}
+    restored = cm.restore_best(learner.init(jax.random.key(5)))
+    assert restored is not None and restored[1]["iteration"] == 2
+    cm.close()
+
+
+def test_checkpoint_restore_none_when_empty(tmp_path):
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    cm = CheckpointManager(str(tmp_path))
+    assert cm.restore(learner.init(jax.random.key(0))) is None
+    assert cm.latest_step() is None
+    cm.close()
+
+
+# -- metrics writer ---------------------------------------------------------
+
+def test_metrics_writer_produces_tb_event_file(tmp_path, capsys):
+    w = MetricsWriter(str(tmp_path), tensorboard=True, console=True)
+    w.write(10, {"loss/total": 1.5, "episode/return": float("nan")})
+    w.write(20, {"loss/total": 1.25})
+    w.close()
+    files = glob.glob(str(tmp_path / "tb" / "train" / "events.out.tfevents.*"))
+    assert len(files) == 1 and os.path.getsize(files[0]) > 0
+    out = capsys.readouterr().out
+    assert "loss/total=1.5" in out
+    assert "episode/return" not in out  # NaN dropped
+
+
+def test_metrics_writer_disabled_backends_are_noop(tmp_path, capsys):
+    w = MetricsWriter(str(tmp_path), tensorboard=False, console=False)
+    w.write(1, {"a": 1.0})
+    w.close()
+    assert glob.glob(str(tmp_path / "tb" / "**"), recursive=False) == []
+    assert capsys.readouterr().out == ""
+
+
+# -- evaluator --------------------------------------------------------------
+
+def test_evaluator_device_env_returns_full_episode_stats():
+    from surreal_tpu.launch.evaluator import Evaluator
+
+    env_cfg = Config(name="jax:pendulum", num_envs=1).extend(
+        base_config().env_config
+    )
+    learner = build_learner(
+        Config(algo=Config(name="ppo")),
+        EnvSpecs(
+            obs=ArraySpec(shape=(3,), dtype=np.dtype(np.float32)),
+            action=ArraySpec(shape=(1,), dtype=np.dtype(np.float32)),
+        ),
+    )
+    state = learner.init(jax.random.key(0))
+    ev = Evaluator(env_cfg, Config(episodes=4, mode="deterministic"), learner)
+    out = ev.evaluate(state, jax.random.key(1))
+    # pendulum episodes truncate at exactly 200 steps; returns are negative costs
+    assert out["eval/length"] == 200.0
+    assert -2000.0 < out["eval/return"] < 0.0
+    ev.close()
+
+
+def test_evaluator_deterministic_is_repeatable_stochastic_varies():
+    from surreal_tpu.launch.evaluator import Evaluator
+
+    env_cfg = Config(name="jax:pendulum", num_envs=1).extend(
+        base_config().env_config
+    )
+    learner = build_learner(
+        Config(algo=Config(name="ppo")),
+        EnvSpecs(
+            obs=ArraySpec(shape=(3,), dtype=np.dtype(np.float32)),
+            action=ArraySpec(shape=(1,), dtype=np.dtype(np.float32)),
+        ),
+    )
+    state = learner.init(jax.random.key(0))
+    det = Evaluator(env_cfg, Config(episodes=2, mode="deterministic"), learner)
+    # same key -> same reset states; deterministic policy -> identical returns
+    a = det.evaluate(state, jax.random.key(7))
+    b = det.evaluate(state, jax.random.key(7))
+    assert a["eval/return"] == b["eval/return"]
+    sto = Evaluator(env_cfg, Config(episodes=2, mode="stochastic"), learner)
+    c = sto.evaluate(state, jax.random.key(7))
+    assert c["eval/return"] != a["eval/return"]
+
+
+# -- end-to-end: kill-and-resume -------------------------------------------
+
+def _trainer_cfg(folder, total_steps, **session_overrides):
+    from surreal_tpu.session.default_configs import base_config
+
+    session = dict(
+        folder=str(folder),
+        total_env_steps=total_steps,
+        metrics=Config(every_n_iters=4, tensorboard=True, console=False),
+        checkpoint=Config(every_n_iters=5),
+        eval=Config(every_n_iters=0),
+    )
+    session.update(session_overrides)
+    return Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=16, epochs=2, num_minibatches=2)
+        ),
+        env_config=Config(name="jax:pendulum", num_envs=8),
+        session_config=Config(**session),
+    ).extend(base_config())
+
+
+def test_trainer_kill_and_resume_continues_curve(tmp_path):
+    from surreal_tpu.launch.trainer import Trainer
+
+    steps_per_iter = 16 * 8
+    # run 1: 12 iterations, checkpoints at 5 and 10 (+ final at 12)
+    t1 = Trainer(_trainer_cfg(tmp_path, 12 * steps_per_iter))
+    s1, _ = t1.run()
+    ckpt_steps = sorted(
+        int(os.path.basename(p))
+        for p in glob.glob(str(tmp_path / "checkpoints" / "*"))
+        if os.path.basename(p).isdigit()
+    )
+    assert 12 in ckpt_steps  # final checkpoint always written
+
+    # run 2: same folder, larger budget -> auto-resumes at iteration 12 and
+    # continues from the SAME params (not a fresh init)
+    t2 = Trainer(_trainer_cfg(tmp_path, 20 * steps_per_iter))
+    seen = []
+    s2, m2 = t2.run(on_metrics=lambda it, m: seen.append(it))
+    assert _params_equal(
+        t2.learner.init(jax.random.key(0)).params, s1.params
+    ) is False  # sanity: resume didn't just re-init
+    assert m2["time/env_steps"] == 20 * steps_per_iter
+    assert min(seen) > 12  # iteration counter continued, not restarted
+    ckpt_steps = sorted(
+        int(os.path.basename(p))
+        for p in glob.glob(str(tmp_path / "checkpoints" / "*"))
+        if os.path.basename(p).isdigit()
+    )
+    assert 20 in ckpt_steps
+
+
+def test_trainer_restore_from_foreign_folder(tmp_path):
+    from surreal_tpu.launch.trainer import Trainer
+
+    steps_per_iter = 16 * 8
+    src = tmp_path / "src"
+    dst = tmp_path / "dst"
+    t1 = Trainer(_trainer_cfg(src, 6 * steps_per_iter))
+    s1, _ = t1.run()
+
+    cfg = _trainer_cfg(
+        dst, 8 * steps_per_iter, checkpoint=Config(every_n_iters=5, restore_from=str(src))
+    )
+    t2 = Trainer(cfg)
+    s2, m2 = t2.run()
+    assert m2["time/env_steps"] == 8 * steps_per_iter  # 6 restored + 2 more
+
+
+# -- launcher/CLI -----------------------------------------------------------
+
+def test_cli_train_then_eval_roundtrip(tmp_path):
+    from surreal_tpu.main.launch import main
+
+    folder = str(tmp_path / "exp")
+    rc = main([
+        "train", "ppo", "jax:pendulum",
+        "--folder", folder, "--num-envs", "8", "--total-steps", "1024",
+        "--set",
+        "learner_config.algo.horizon=16",
+        "session_config.metrics.every_n_iters=4",
+        "session_config.metrics.tensorboard=false",
+        "session_config.metrics.console=false",
+        "session_config.eval.every_n_iters=0",
+    ])
+    assert rc == 0
+    assert os.path.exists(os.path.join(folder, "config.json"))
+    assert glob.glob(os.path.join(folder, "checkpoints", "*"))
+
+    rc = main(["eval", "--folder", folder, "--episodes", "2"])
+    assert rc == 0
+
+
+def test_cli_selects_trainer_by_algo_and_env():
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.main.launch import build_config, select_trainer
+
+    class A:
+        algo, env, num_envs, folder = "ddpg", "jax:pendulum", 16, "/tmp/sel1"
+        total_steps = restore_from = None
+        set = []
+
+    cfg = build_config(A)
+    assert isinstance(select_trainer(cfg), OffPolicyTrainer)
+
+    class B(A):
+        algo, env, folder = "ppo", "jax:cartpole", "/tmp/sel2"
+
+    assert isinstance(select_trainer(build_config(B)), Trainer)
