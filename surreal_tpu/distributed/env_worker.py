@@ -48,7 +48,16 @@ def run_env_worker(
         stop_event is not None and stop_event.is_set()
     ):
         sock.send(pickle.dumps(msg, protocol=5))
-        if not sock.poll(10_000):
+        # poll in short slices so a stop request (set while we wait on a
+        # server that already shut down) exits cleanly instead of raising
+        for _ in range(100):
+            if sock.poll(100):
+                break
+            if stop_event is not None and stop_event.is_set():
+                sock.close(0)
+                env.close()
+                return steps
+        else:
             raise TimeoutError(f"worker {worker_id}: inference server silent for 10s")
         actions = pickle.loads(sock.recv())
         out = env.step(actions)

@@ -91,10 +91,19 @@ def rescale_canonical_action(
 
 
 class HostEnv(abc.ABC):
-    """Batched, auto-resetting host environment."""
+    """Batched, auto-resetting host environment.
+
+    ``pre_reset_hook`` — optional callable ``(i, env) -> None`` that
+    adapters invoke for env ``i`` immediately before its auto-reset, while
+    the terminal state is still live. This is the seam wrappers that derive
+    observations from live env state (e.g. rendered pixels) use to capture
+    the TRUE terminal observation; without it a render after ``step`` sees
+    the next episode's first frame.
+    """
 
     specs: EnvSpecs
     num_envs: int
+    pre_reset_hook = None
 
     @abc.abstractmethod
     def reset(self, seed: int | None = None) -> np.ndarray:

@@ -66,6 +66,8 @@ class DmControlAdapter(HostEnv):
                 # dm_control suite episodes end by time limit (discount==1.0
                 # at the boundary means truncation, not termination)
                 truncated_b[i] = ts.discount is None or ts.discount > 0.0
+                if self.pre_reset_hook is not None:
+                    self.pre_reset_hook(i, env)
                 obs = _flatten_obs(env.reset().observation)
             obs_b.append(obs)
             rew_b.append(0.0 if ts.reward is None else ts.reward)

@@ -80,6 +80,8 @@ class GymAdapter(HostEnv):
             if done:
                 terminal_obs[i] = obs
                 truncated_b[i] = truncated and not terminated
+                if self.pre_reset_hook is not None:
+                    self.pre_reset_hook(i, env)
                 obs, _ = env.reset()
             obs_b.append(obs)
             rew_b.append(reward)

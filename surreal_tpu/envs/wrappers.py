@@ -169,26 +169,45 @@ class PixelObsWrapper(HostWrapper):
             env.specs,
             obs=ArraySpec(shape=(h, w, 3), dtype=np.dtype(np.uint8), name="pixels"),
         )
+        # capture the TRUE terminal frame while the episode's last state is
+        # still live: the adapter fires this right before its auto-reset
+        # (time-limit-truncated pixel episodes bootstrap off this frame; a
+        # post-reset render would be the NEXT episode's first frame).
+        # Install on the innermost adapter — an instance attribute on an
+        # intermediate wrapper would shadow nothing (the adapter checks its
+        # OWN attribute) and the hook would silently never fire.
+        self._terminal_frames: dict[int, np.ndarray] = {}
+        adapter = env
+        while isinstance(adapter, HostWrapper):
+            adapter = adapter.env
+        adapter.pre_reset_hook = self._capture_terminal
+
+    def _render_one(self, env) -> np.ndarray:
+        frame = np.asarray(env.render())
+        return _nn_resize(frame, self.image_size).astype(np.uint8)
+
+    def _capture_terminal(self, i: int, env) -> None:
+        self._terminal_frames[i] = self._render_one(env)
 
     def _grab(self) -> np.ndarray:
-        frames = []
-        for env in self.env.envs:
-            frame = np.asarray(env.render())
-            frames.append(_nn_resize(frame, self.image_size))
-        return np.stack(frames).astype(np.uint8)
+        return np.stack([self._render_one(env) for env in self.env.envs])
 
     def reset(self, seed: int | None = None) -> np.ndarray:
         self.env.reset(seed)
+        self._terminal_frames.clear()
         return self._grab()
 
     def step(self, actions: np.ndarray) -> StepOutput:
+        self._terminal_frames.clear()
         out = self.env.step(actions)
         pixels = self._grab()
         info = dict(out.info)
-        # post-reset render; true terminal frame is unavailable without a
-        # pre-reset hook, so reuse the last frame as the bootstrap obs. For
-        # pixel tasks terminal bootstrap values are rarely used (episodic).
-        info["terminal_obs"] = pixels
+        terminal = pixels
+        if self._terminal_frames:
+            terminal = pixels.copy()
+            for i, frame in self._terminal_frames.items():
+                terminal[i] = frame
+        info["terminal_obs"] = terminal
         return StepOutput(obs=pixels, reward=out.reward, done=out.done, info=info)
 
 

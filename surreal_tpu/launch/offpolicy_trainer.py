@@ -41,6 +41,27 @@ class OffPolicyCarry(NamedTuple):
 TRANS_KEYS = ("obs", "next_obs", "action", "reward", "done", "terminated")
 
 
+def scrub_fake_prefix_windows(trans, n: int, B: int):
+    """Overwrite the n-1 fictitious leading windows of the run's FIRST
+    folded chunk with its first real window.
+
+    ``nstep_transitions`` flattens [S, B] windows row-major, so window s of
+    env b is flat row ``s*B+b``: the fabricated rows (windows starting in
+    the all-zero tail that seeds the cross-chunk carry) occupy
+    ``[0, (n-1)*B)`` and the first real window block is ``[(n-1)*B, n*B)``.
+    Tiling that block over the fake rows keeps per-env alignment and static
+    shapes under jit; duplicating B real transitions n-1 times, once per
+    run, is harmless — replay never holds made-up transitions.
+    """
+    nb = (n - 1) * B
+    return jax.tree.map(
+        lambda x: x.at[:nb].set(
+            jnp.tile(x[nb : nb + B], (n - 1, *([1] * (x.ndim - 1))))
+        ),
+        trans,
+    )
+
+
 class OffPolicyTrainer:
     def __init__(self, config):
         self.config = config
@@ -115,7 +136,6 @@ class OffPolicyTrainer:
             action = jnp.where(warmup, random_action, action)
             env_state, obs2, reward, done, info = batch_step(self.env, c.env_state, action)
             next_obs, terminated = successor_and_termination(obs2, done, info)
-            done_b = done.reshape(done.shape + (1,) * (obs2.ndim - done.ndim))
             ep_return = c.ep_return + reward
             ep_length = c.ep_length + 1
             trans = {
@@ -131,8 +151,9 @@ class OffPolicyTrainer:
             new_c = c._replace(
                 env_state=env_state,
                 obs=obs2,
-                # reset OU state at episode boundaries
-                noise=jnp.where(done_b, 0.0, noise),
+                # reset OU state at episode boundaries; mask is rank-matched
+                # to the [B, act_dim] noise, independent of the obs rank
+                noise=jnp.where(done[:, None], 0.0, noise),
                 ep_return=jnp.where(done, 0.0, ep_return),
                 ep_length=jnp.where(done, 0, ep_length),
             )
@@ -142,7 +163,7 @@ class OffPolicyTrainer:
         return jax.lax.scan(step, carry, keys)
 
     def _device_train_iter(
-        self, state, replay_state, carry, key, beta, warmup, axis_name=None
+        self, state, replay_state, carry, key, beta, warmup, first, axis_name=None
     ):
         rkey, ukey = jax.random.split(key)
         carry, traj = self._rollout(state, carry, rkey, warmup)
@@ -161,6 +182,16 @@ class OffPolicyTrainer:
         else:
             full = chunk
         trans = nstep_transitions(full, self.algo.gamma, n)
+        if n > 1:
+            # the very first chunk's prepended tail is fabricated (no
+            # previous chunk exists), so the n-1 windows starting inside it
+            # are fictitious (obs=0, action=0) — scrub them before insert.
+            trans = jax.lax.cond(
+                first,
+                lambda t: scrub_fake_prefix_windows(t, n, chunk["reward"].shape[1]),
+                lambda t: t,
+                trans,
+            )
         replay_state = self.replay.insert(replay_state, trans)
         # obs-normalizer: fold each fresh obs exactly once per chunk
         state = self.learner.update_obs_stats(state, chunk["obs"], axis_name)
@@ -300,6 +331,7 @@ class OffPolicyTrainer:
                 replay_state = sharded_replay_init(self.replay, example, self.mesh)
             else:
                 replay_state = self.replay.init(example)
+            first_call = True
             while env_steps < total:
                 key, it_key, hk_key = jax.random.split(key, 3)
                 beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
@@ -307,8 +339,10 @@ class OffPolicyTrainer:
                     env_steps < self.algo.exploration.warmup_steps
                 )
                 state, replay_state, carry, metrics = self._train_iter(
-                    state, replay_state, carry, it_key, beta, warmup
+                    state, replay_state, carry, it_key, beta, warmup,
+                    jnp.asarray(first_call),
                 )
+                first_call = False
                 iteration += 1
                 env_steps += steps_per_iter
                 _, stop = hooks.end_iteration(

@@ -68,10 +68,10 @@ def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
     """
     from surreal_tpu.replay.sharded import replay_state_specs
 
-    def sharded_iter(state, replay_state, carry, key, beta, warmup):
+    def sharded_iter(state, replay_state, carry, key, beta, warmup, first):
         key = jax.random.fold_in(key, jax.lax.axis_index(axis))
         return trainer_iter(
-            state, replay_state, carry, key, beta, warmup, axis_name=axis
+            state, replay_state, carry, key, beta, warmup, first, axis_name=axis
         )
 
     def carry_specs(carry):
@@ -85,7 +85,7 @@ def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
             tail=None if carry.tail is None else _spec_like(carry.tail, P(None, axis)),
         )
 
-    def wrapped(state, replay_state, carry, key, beta, warmup):
+    def wrapped(state, replay_state, carry, key, beta, warmup, first):
         shard = shard_map(
             sharded_iter,
             mesh=mesh,
@@ -93,6 +93,7 @@ def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
                 _spec_like(state, P()),
                 replay_state_specs(replay_state, axis),
                 carry_specs(carry),
+                P(),
                 P(),
                 P(),
                 P(),
@@ -105,7 +106,7 @@ def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
             ),
             check_vma=False,
         )
-        return shard(state, replay_state, carry, key, beta, warmup)
+        return shard(state, replay_state, carry, key, beta, warmup, first)
 
     return jax.jit(wrapped)
 

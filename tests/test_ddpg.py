@@ -130,6 +130,57 @@ def test_nstep_transitions_golden():
     np.testing.assert_allclose(np.asarray(out["next_obs"][2]), 104.0)
 
 
+def test_scrub_fake_prefix_windows_removes_all_fabricated_rows():
+    """The run's first chunk is folded with an all-zero fabricated tail
+    prepended; every one of the (n-1)*B windows starting inside it must be
+    replaced by the first REAL window block, per-env aligned (regression:
+    the scrub once indexed window counts into the flattened [S*B] layout
+    and left all fake rows in place for B > 1)."""
+    from surreal_tpu.launch.offpolicy_trainer import scrub_fake_prefix_windows
+
+    T, B, n, gamma = 4, 3, 3, 0.9
+    # fabricated tail exactly as OffPolicyTrainer builds it
+    fake = dict(
+        obs=jnp.zeros((n - 1, B, 2)),
+        next_obs=jnp.zeros((n - 1, B, 2)),
+        action=jnp.zeros((n - 1, B, 1)),
+        reward=jnp.zeros((n - 1, B)),
+        done=jnp.ones((n - 1, B), bool),
+        terminated=jnp.ones((n - 1, B), bool),
+    )
+    # real chunk: obs encodes (time, env) so rows are distinguishable
+    t_idx = jnp.arange(1, T + 1, dtype=jnp.float32)[:, None, None]
+    b_idx = jnp.arange(1, B + 1, dtype=jnp.float32)[None, :, None]
+    obs = jnp.concatenate([t_idx * jnp.ones((T, B, 1)), b_idx * jnp.ones((T, B, 1))], -1)
+    real = dict(
+        obs=obs,
+        next_obs=obs + 100.0,
+        action=jnp.ones((T, B, 1)),
+        reward=jnp.ones((T, B)),
+        done=jnp.zeros((T, B), bool),
+        terminated=jnp.zeros((T, B), bool),
+    )
+    full = jax.tree.map(lambda a, b: jnp.concatenate([a, b], 0), fake, real)
+    trans = nstep_transitions(full, gamma, n)
+    out = scrub_fake_prefix_windows(trans, n, B)
+
+    nb = (n - 1) * B
+    # no all-zero obs row survives anywhere
+    assert not bool(jnp.any(jnp.all(out["obs"] == 0.0, axis=-1)))
+    # fake rows were replaced by the first real window block, env-aligned
+    for s in range(n - 1):
+        np.testing.assert_array_equal(
+            np.asarray(out["obs"][s * B : (s + 1) * B]),
+            np.asarray(out["obs"][nb : nb + B]),
+        )
+    # real rows untouched
+    np.testing.assert_array_equal(
+        np.asarray(out["obs"][nb:]), np.asarray(trans["obs"][nb:])
+    )
+    # the real block's per-env identity is intact (env column = 1..B)
+    np.testing.assert_allclose(np.asarray(out["obs"][:B, 1]), np.arange(1, B + 1))
+
+
 def test_nstep_truncation_keeps_bootstrap():
     """Truncated (not terminated) boundary: discount stays nonzero so the
     learner bootstraps from the terminal obs."""

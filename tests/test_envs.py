@@ -161,6 +161,33 @@ def test_grayscale_wrapper_shapes():
     assert obs.dtype == np.uint8
 
 
+def test_pixel_obs_wrapper_captures_true_terminal_frame():
+    """At an episode boundary ``terminal_obs`` must be the PRE-reset frame
+    (captured via the adapter's pre_reset_hook), not the next episode's
+    first frame — the value-bootstrap bias the advisor flagged."""
+    from surreal_tpu.envs.gym_adapter import GymAdapter
+    from surreal_tpu.envs.wrappers import PixelObsWrapper
+
+    env = PixelObsWrapper(
+        GymAdapter("CartPole-v1", num_envs=1, render_mode="rgb_array"),
+        image_size=(84, 84),
+    )
+    obs = env.reset(seed=0)
+    assert obs.shape == (1, 84, 84, 3) and obs.dtype == np.uint8
+    # constant push topples the pole within a few steps
+    for _ in range(50):
+        out = env.step(np.array([1]))
+        if out.done[0]:
+            break
+    assert out.done[0], "cartpole did not terminate under constant action"
+    term = out.info["terminal_obs"]
+    assert term.shape == out.obs.shape
+    # the terminal frame (pole tilted at failure) differs from the
+    # post-reset frame (pole recentered) the wrapper reports as obs
+    assert not np.array_equal(term[0], out.obs[0])
+    env.close()
+
+
 # -- jax:lift (BlockLifting-class north-star workload) ----------------------
 
 def _lift_scripted_action(state):
