@@ -18,6 +18,7 @@ from surreal_tpu.distributed.param_service import (
     ParameterClient,
     ParameterPublisher,
     ParameterServer,
+    ShardedParameterServer,
 )
 
 __all__ = [
@@ -29,4 +30,5 @@ __all__ = [
     "ParameterClient",
     "ParameterPublisher",
     "ParameterServer",
+    "ShardedParameterServer",
 ]

@@ -49,8 +49,10 @@ def run_env_worker(
     ):
         sock.send(pickle.dumps(msg, protocol=5))
         # poll in short slices so a stop request (set while we wait on a
-        # server that already shut down) exits cleanly instead of raising
-        for _ in range(100):
+        # server that already shut down) exits cleanly instead of raising.
+        # The budget is generous because the server's first replies wait on
+        # XLA compiles (tens of seconds on a tunneled TPU).
+        for _ in range(1200):
             if sock.poll(100):
                 break
             if stop_event is not None and stop_event.is_set():
@@ -58,7 +60,7 @@ def run_env_worker(
                 env.close()
                 return steps
         else:
-            raise TimeoutError(f"worker {worker_id}: inference server silent for 10s")
+            raise TimeoutError(f"worker {worker_id}: inference server silent for 120s")
         actions = pickle.loads(sock.recv())
         out = env.step(actions)
         steps += env.num_envs

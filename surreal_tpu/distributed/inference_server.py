@@ -69,6 +69,10 @@ class InferenceServer:
 
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
+        # a respawned worker reuses its dead predecessor's identity; without
+        # handover the ROUTER silently drops the new connection while the
+        # old one lingers (e.g. a SIGKILLed process never sent a disconnect)
+        self._sock.setsockopt(zmq.ROUTER_HANDOVER, 1)
         self._sock.bind(bind)
         self.address = self._sock.getsockopt_string(zmq.LAST_ENDPOINT)
         self._tracks: dict[bytes, _WorkerTrack] = {}
@@ -138,6 +142,13 @@ class InferenceServer:
 
     def _record(self, ident: bytes, msg: dict, actions, info) -> None:
         track = self._tracks.setdefault(ident, _WorkerTrack())
+        if "reward" not in msg and track.steps:
+            # obs-only hello on an identity that already has partial steps:
+            # a respawned worker replacing a dead one. Its fresh episode
+            # must not be spliced onto the dead worker's half-built chunk
+            # (no done boundary would separate them, and GAE/V-trace would
+            # bootstrap across the hidden reset) — drop the partial chunk.
+            track.steps = []
         if track.pending is not None and "reward" in msg:
             prev = track.pending
             done = np.asarray(msg["done"])

@@ -11,17 +11,19 @@ external consumers — over pyzmq, exactly the reference's pub/sub + req/rep
 shape.
 
 Sharding note: the reference sharded its PS because one process couldn't
-serve 1000 actor clients. Here the client population is a handful of eval
-workers (actors collapsed into the program), so one server suffices; the
-class still accepts multiple bind addresses for parity with
-ShardedParameterServer.
+serve 1000 actor clients. Here the client population is typically a
+handful of eval workers (actors collapsed into the program), so one server
+usually suffices — but both sharding axes are kept for parity:
+:class:`ParameterServer` accepts multiple bind addresses (one REP socket
+serving several endpoints), and :class:`ShardedParameterServer` runs N
+independent server shards with deterministic client->shard routing.
 """
 
 from __future__ import annotations
 
 import threading
-import time
-from typing import Any
+import zlib
+from typing import Any, Sequence
 
 import zmq
 
@@ -53,17 +55,29 @@ class ParameterServer:
     """Caches the latest published params; serves REQ/REP fetches.
 
     Runs a background thread (SUB from the publisher, REP to clients) —
-    the reference's standalone PS process shrunk to a thread.
+    the reference's standalone PS process shrunk to a thread. ``bind`` may
+    be one address or several: the REP socket binds every endpoint and
+    serves them all (``addresses`` lists the resolved endpoints;
+    ``address`` is the first, for single-endpoint callers).
     """
 
-    def __init__(self, publisher_address: str, bind: str = "tcp://127.0.0.1:*"):
+    def __init__(
+        self,
+        publisher_address: str,
+        bind: str | Sequence[str] = "tcp://127.0.0.1:*",
+    ):
         self._ctx = zmq.Context.instance()
         self._sub = self._ctx.socket(zmq.SUB)
         self._sub.connect(publisher_address)
         self._sub.setsockopt(zmq.SUBSCRIBE, b"params")
         self._rep = self._ctx.socket(zmq.REP)
-        self._rep.bind(bind)
-        self.address = self._rep.getsockopt_string(zmq.LAST_ENDPOINT)
+        binds = [bind] if isinstance(bind, str) else list(bind)
+        self.addresses: list[str] = []
+        for b in binds:
+            self._rep.bind(b)
+            # LAST_ENDPOINT resolves wildcard ports for the most recent bind
+            self.addresses.append(self._rep.getsockopt_string(zmq.LAST_ENDPOINT))
+        self.address = self.addresses[0]
         self._latest: tuple[int, bytes] | None = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -97,6 +111,50 @@ class ParameterServer:
         self._thread.join(timeout=2)
         self._sub.close(0)
         self._rep.close(0)
+
+
+class ShardedParameterServer:
+    """N independent :class:`ParameterServer` shards subscribed to the same
+    publisher, with deterministic client->shard routing (parity: reference
+    ``ShardedParameterServer`` — scale REQ/REP fan-out when the client
+    population outgrows one server's socket loop).
+
+    Each shard caches the publisher's latest snapshot independently, so any
+    shard answers any client; routing exists purely to spread load.
+    """
+
+    def __init__(
+        self,
+        publisher_address: str,
+        num_shards: int = 2,
+        binds: Sequence[str] | None = None,
+    ):
+        """``binds`` gives each shard its endpoint (e.g. non-loopback
+        interfaces / fixed ports so remote eval workers can connect);
+        default is one wildcard loopback port per shard."""
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if binds is not None and len(binds) != num_shards:
+            raise ValueError(
+                f"got {len(binds)} bind addresses for {num_shards} shards"
+            )
+        if binds is None:
+            binds = ["tcp://127.0.0.1:*"] * num_shards
+        self.shards = [
+            ParameterServer(publisher_address, bind=b) for b in binds
+        ]
+        self.addresses = [s.address for s in self.shards]
+
+    def address_for(self, client_id: str) -> str:
+        """Deterministic shard route for a client (crc32, stable across
+        processes — unlike the builtin salted ``hash``)."""
+        return self.addresses[
+            zlib.crc32(client_id.encode()) % len(self.addresses)
+        ]
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()
 
 
 class ParameterClient:

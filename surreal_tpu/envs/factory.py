@@ -74,7 +74,8 @@ def make_env(env_config) -> AnyEnv:
         from surreal_tpu.envs.gym_adapter import GymAdapter
 
         kwargs = {}
-        if env_config.pixel_obs:
+        if env_config.pixel_obs or env_config.video.enabled:
+            # both pixel obs and video recording need rendered frames
             kwargs["render_mode"] = "rgb_array"
         env: HostEnv = GymAdapter(
             env_id, num_envs=env_config.num_envs, seed=env_config.seed, **kwargs
@@ -87,9 +88,21 @@ def make_env(env_config) -> AnyEnv:
             domain, task, num_envs=env_config.num_envs, seed=env_config.seed
         )
     elif backend == "robosuite":
-        raise ImportError(
-            "robosuite is not installed in this image (SURVEY.md §7); "
-            "use the MJX lifting env 'jax:lift' for BlockLifting-class workloads"
+        try:
+            import robosuite  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "robosuite is not installed in this image (SURVEY.md §7); "
+                "use the on-device BlockLifting-class env 'jax:lift' for "
+                "Robosuite-class workloads"
+            ) from e
+        from surreal_tpu.envs.robosuite_adapter import RobosuiteAdapter
+
+        env = RobosuiteAdapter(
+            env_id,
+            num_envs=env_config.num_envs,
+            seed=env_config.seed,
+            renderable=bool(env_config.pixel_obs or env_config.video.enabled),
         )
     else:
         raise ValueError(f"unknown env backend {backend!r}")

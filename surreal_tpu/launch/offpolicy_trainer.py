@@ -396,6 +396,7 @@ class OffPolicyTrainer:
             host_tail = None
 
         recent_returns: list = []
+        first_chunk = True
         while env_steps < total:
             steps = []
             warmup = env_steps < explo.warmup_steps
@@ -444,6 +445,13 @@ class OffPolicyTrainer:
             else:
                 full = traj
             trans = self._nstep(full)
+            if host_tail is not None and first_chunk:
+                # same scrub as the device path: the run's first prepended
+                # tail is fabricated, so its windows must not enter replay
+                trans = scrub_fake_prefix_windows(
+                    trans, self.algo.n_step, self.num_envs
+                )
+            first_chunk = False
             replay_state = self._insert(replay_state, trans)
             state = self.learner.update_obs_stats(state, traj["obs"])
             if bool(self.replay.can_sample(replay_state)):

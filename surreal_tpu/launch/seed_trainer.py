@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable
 
 import jax
@@ -60,43 +61,73 @@ class SEEDTrainer:
         self._jit_act = jax.jit(self.learner.act, static_argnames="mode")
         self._learn = jax.jit(self.learner.learn)
 
-    def _spawn_workers(self, env_cfg, address, stop):
-        """Start env workers as threads or subprocesses; returns the list.
+    def _spawn_one(self, i: int, env_cfg, address, stop):
+        """Start env worker ``i`` as a thread or subprocess.
 
         Process mode uses the ``spawn`` start method: forking after jax/zmq
         have started threads is unsafe, and workers only need numpy + the
         host env anyway.
         """
-        workers = []
         if self.worker_mode == "process":
             import multiprocessing as mp
 
             ctx = mp.get_context("spawn")
-            for i in range(self.num_workers):
-                p = ctx.Process(
-                    target=run_env_worker,
-                    args=(env_cfg.to_dict(), address, i),
-                    daemon=True,
-                )
-                p.start()
-                workers.append(p)
+            w = ctx.Process(
+                target=run_env_worker,
+                args=(env_cfg.to_dict(), address, i),
+                daemon=True,
+            )
         else:
-            for i in range(self.num_workers):
-                t = threading.Thread(
-                    target=run_env_worker,
-                    args=(env_cfg, address, i),
-                    kwargs={"stop_event": stop},
-                    daemon=True,
-                )
-                t.start()
-                workers.append(t)
-        return workers
+            w = threading.Thread(
+                target=run_env_worker,
+                args=(env_cfg, address, i),
+                kwargs={"stop_event": stop},
+                daemon=True,
+            )
+        w.start()
+        return w
+
+    def _spawn_workers(self, env_cfg, address, stop):
+        return [
+            self._spawn_one(i, env_cfg, address, stop)
+            for i in range(self.num_workers)
+        ]
+
+    def _respawn_dead_workers(self, workers, env_cfg, address, stop) -> int:
+        """Workers are expendable (SURVEY.md §5.3: the reference delegated
+        actor recovery to Kubernetes restart policies; here the trainer IS
+        the supervisor): any dead worker is replaced in-place. Safe because
+        workers are stateless — a fresh worker re-opens its DEALER socket
+        under the same identity and the server's first message from it
+        (obs-only) replaces the stale pending state without fabricating a
+        transition."""
+        respawned = 0
+        for i, w in enumerate(workers):
+            if not w.is_alive():
+                workers[i] = self._spawn_one(i, env_cfg, address, stop)
+                respawned += 1
+        return respawned
 
     def _make_act_fn(self, state, key_holder):
         def act_fn(obs_np):
+            # pad the micro-batch to the next power of two: the server
+            # coalesces a VARIABLE number of worker requests per forward,
+            # and every distinct batch size is a fresh XLA compile — with
+            # padding the compile count is log2-bounded and the steady
+            # state reuses one cached executable
+            n = obs_np.shape[0]
+            padded = 1 << (n - 1).bit_length()
+            if padded != n:
+                obs_np = np.concatenate(
+                    [obs_np, np.repeat(obs_np[-1:], padded - n, axis=0)], axis=0
+                )
             key_holder[0], sub = jax.random.split(key_holder[0])
             actions, info = self._jit_act(state, obs_np, sub, mode="training")
-            return np.asarray(actions), {k: np.asarray(v) for k, v in info.items()}
+            # one transfer for the whole result pytree: per-array np.asarray
+            # would pay the host<->device round trip once per array, which
+            # dominates serve latency on tunneled/remote TPUs
+            actions, info = jax.device_get((actions, info))
+            return actions[:n], {k: v[:n] for k, v in info.items()}
 
         return act_fn
 
@@ -124,16 +155,45 @@ class SEEDTrainer:
             server = InferenceServer(
                 act_fn=self._make_act_fn(state, key_holder),
                 unroll_length=self.algo.horizon,
+                # coalesce all workers into one forward per lockstep round:
+                # with min_batch=1 a W-worker fleet degrades to ~W serves
+                # per round, and serve latency (not compute) is the bound
+                min_batch=self.num_workers,
+                max_wait_ms=5.0,
             )
             env_cfg = training_env_config(self.config.env_config)
             workers = self._spawn_workers(env_cfg, server.address, stop)
+            self._workers = workers  # exposed for tests/fault injection
 
             dropped_stale = 0
+            respawns = 0
+            # the FIRST chunk waits out the policy's XLA compiles plus a
+            # full unroll of round trips (can be minutes on a tunneled
+            # TPU); workers keep their own 120s liveness budget per step,
+            # reset by each served reply
+            chunk_timeout = 600.0
+
+            def next_chunk(deadline_s: float):
+                """Wait for a chunk, supervising workers on every empty
+                poll — a dead SOLE worker must be respawned while waiting,
+                not after a chunk it can no longer produce."""
+                nonlocal respawns
+                deadline = time.monotonic() + deadline_s
+                while True:
+                    try:
+                        return server.chunks.get(timeout=2.0)
+                    except queue.Empty:
+                        respawns += self._respawn_dead_workers(
+                            workers, env_cfg, server.address, stop
+                        )
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                "no experience chunks arriving from workers"
+                            ) from None
+
             while env_steps < total:
-                try:
-                    chunk = server.chunks.get(timeout=30)
-                except queue.Empty:
-                    raise TimeoutError("no experience chunks arriving from workers")
+                chunk = next_chunk(chunk_timeout)
+                chunk_timeout = 30.0
                 versions = chunk.pop("param_version")
                 staleness = server.version - int(versions.min())
                 if self.max_staleness is not None and staleness > self.max_staleness:
@@ -145,11 +205,15 @@ class SEEDTrainer:
                 server.set_act_fn(self._make_act_fn(state, key_holder))
                 iteration += 1
                 env_steps += chunk["reward"].shape[0] * chunk["reward"].shape[1]
+                respawns += self._respawn_dead_workers(
+                    workers, env_cfg, server.address, stop
+                )
                 metrics = dict(
                     metrics,
                     **{
                         "staleness/updates_behind": float(staleness),
                         "staleness/dropped_chunks": float(dropped_stale),
+                        "workers/respawns": float(respawns),
                     },
                 )
                 _, stop_flag = hooks.end_iteration(

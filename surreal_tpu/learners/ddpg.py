@@ -159,6 +159,9 @@ class DDPGLearner(Learner):
         are read-only here; see :meth:`update_obs_stats`.
         """
         del key
+        from surreal_tpu.utils.asserts import check_learn_batch
+
+        check_learn_batch(batch, self.specs, name="ddpg.learn")
         algo = self.config.algo
         obs_stats = state.obs_stats
         obs = self._norm_obs(obs_stats, batch["obs"])

@@ -124,6 +124,9 @@ class IMPALALearner(Learner):
     # -- learning ------------------------------------------------------------
     def learn(self, state: IMPALAState, batch: dict, key: jax.Array, axis_name=None):
         del key
+        from surreal_tpu.utils.asserts import check_learn_batch
+
+        check_learn_batch(batch, self.specs, name="impala.learn")
         algo = self.config.algo
         if self._use_obs_filter:
             obs_stats = update_stats(state.obs_stats, batch["obs"], axis_name=axis_name)

@@ -167,6 +167,9 @@ class PPOLearner(Learner):
         advantage normalization are psum-merged so every replica applies the
         identical update — the TPU ICI replacement for the reference's
         single-GPU learner + parameter server (SURVEY.md §5.8)."""
+        from surreal_tpu.utils.asserts import check_learn_batch
+
+        check_learn_batch(batch, self.specs, name="ppo.learn")
         algo = self.config.algo
         T, B = batch["reward"].shape
 

@@ -18,17 +18,45 @@ import jax
 import jax.numpy as jnp
 
 
+# Saturation cap for the sample count: int32 arithmetic is EXACT (a float32
+# count stops incrementing at 2^24 single samples — ~100 s of training at
+# the 100k steps/s north star), and past ~2e9 samples the normalizer is
+# statistically converged. At the cap the update degrades gracefully into
+# an exponential moving estimate with horizon _COUNT_CAP: the prior
+# (count, m2) pair is rescaled so count + batch stays exactly at the cap —
+# rescaling BOTH keeps variance = m2/count consistent (clamping count alone
+# while m2 kept accumulating would inflate variance without bound).
+_COUNT_CAP = 2_000_000_000
+
+
 class RunningStats(NamedTuple):
-    count: jax.Array  # scalar float (float64-unsafe platforms: float32 is fine for <1e7 steps)
+    count: jax.Array  # scalar int32 sample count (exact; saturates at _COUNT_CAP)
     mean: jax.Array   # [obs_dim...]
     m2: jax.Array     # [obs_dim...] sum of squared deviations
 
 
 def init_stats(obs_shape: tuple[int, ...], dtype=jnp.float32) -> RunningStats:
     return RunningStats(
-        count=jnp.asarray(1e-4, dtype),  # epsilon count avoids div-by-zero
+        count=jnp.zeros((), jnp.int32),
         mean=jnp.zeros(obs_shape, dtype),
         m2=jnp.zeros(obs_shape, dtype),
+    )
+
+
+def _fcount(count: jax.Array) -> jax.Array:
+    """Count as float for ratio math, guarded against the pre-update zero."""
+    return jnp.maximum(count, 1).astype(jnp.float32)
+
+
+def _clamped_total(a: jax.Array, b: jax.Array, raw_tot_f: jax.Array) -> jax.Array:
+    """``min(a + b, _COUNT_CAP)`` that cannot wrap: the exact int32 clamp
+    handles the normal range, and the float sum (accurate to ~256 at this
+    magnitude) flags the far-over-cap case where the int32 add itself
+    would overflow (true total > 2^31-1)."""
+    return jnp.where(
+        raw_tot_f > 2_100_000_000.0,  # < int32 max, comfortably > cap
+        jnp.asarray(_COUNT_CAP, jnp.int32),
+        jnp.minimum(a + b, _COUNT_CAP),
     )
 
 
@@ -47,7 +75,7 @@ def update_stats(
         jnp.prod(jnp.asarray([batch.shape[i] for i in reduce_axes], jnp.int32))
         if reduce_axes
         else 1,
-        stats.count.dtype,
+        jnp.int32,
     )
     b_mean = jnp.mean(batch, axis=reduce_axes) if reduce_axes else batch
     b_m2 = (
@@ -58,33 +86,63 @@ def update_stats(
     if axis_name is not None:
         # Chan merge of per-replica batch moments (exact, order-free)
         n = jax.lax.psum(b_count, axis_name)
-        g_mean = jax.lax.psum(b_mean * b_count, axis_name) / n
+        nf = n.astype(jnp.float32)
+        bf = b_count.astype(jnp.float32)
+        g_mean = jax.lax.psum(b_mean * bf, axis_name) / nf
         b_m2 = jax.lax.psum(
-            b_m2 + b_count * (b_mean - g_mean) ** 2, axis_name
+            b_m2 + bf * (b_mean - g_mean) ** 2, axis_name
         )
         b_count, b_mean = n, g_mean
     delta = b_mean - stats.mean
-    tot = stats.count + b_count
-    new_mean = stats.mean + delta * (b_count / tot)
-    new_m2 = stats.m2 + b_m2 + delta**2 * (stats.count * b_count / tot)
+    # cf must stay a true 0 on the first fold (zeroes the delta^2 cross
+    # term); at the cap, rescale the prior so count + batch = cap exactly
+    # (EMA with horizon _COUNT_CAP — see the cap comment above)
+    cf = stats.count.astype(jnp.float32)
+    bf = b_count.astype(jnp.float32)
+    raw_tot = cf + bf  # float: immune to int32 overflow at the cap edge
+    scale = jnp.where(
+        raw_tot > _COUNT_CAP,
+        jnp.maximum(_COUNT_CAP - bf, 0.0) / jnp.maximum(cf, 1.0),
+        1.0,
+    )
+    cf = cf * scale
+    m2 = stats.m2 * scale
+    tot = _clamped_total(stats.count, b_count, raw_tot)
+    tf = tot.astype(jnp.float32)
+    new_mean = stats.mean + delta * (bf / tf)
+    new_m2 = m2 + b_m2 + delta**2 * (cf * (bf / tf))
     return RunningStats(count=tot, mean=new_mean, m2=new_m2)
 
 
 def merge_stats(a: RunningStats, b: RunningStats) -> RunningStats:
-    """Merge two independent stats (used for cross-replica psum-style merge)."""
-    tot = a.count + b.count
+    """Merge two independent stats (used for cross-replica psum-style
+    merge). At the cap, ``a`` is rescaled the same EMA way as
+    :func:`update_stats` so variance stays consistent with the clamped
+    count."""
+    af = a.count.astype(jnp.float32)
+    bf = b.count.astype(jnp.float32)
+    scale = jnp.where(
+        af + bf > _COUNT_CAP,
+        jnp.maximum(_COUNT_CAP - bf, 0.0) / jnp.maximum(af, 1.0),
+        1.0,
+    )
+    raw_tot = af + bf
+    af = af * scale
+    a_m2 = a.m2 * scale
+    tot = _clamped_total(a.count, b.count, raw_tot)
+    tf = _fcount(tot)
     delta = b.mean - a.mean
     return RunningStats(
         count=tot,
-        mean=a.mean + delta * (b.count / tot),
-        m2=a.m2 + b.m2 + delta**2 * (a.count * b.count / tot),
+        mean=a.mean + delta * (bf / tf),
+        m2=a_m2 + b.m2 + delta**2 * (af * (bf / tf)),
     )
 
 
 def normalize(stats: RunningStats, x: jax.Array, clip: float = 5.0) -> jax.Array:
-    std = jnp.sqrt(stats.m2 / stats.count + 1e-8)
+    std = jnp.sqrt(stats.m2 / _fcount(stats.count) + 1e-8)
     return jnp.clip((x - stats.mean) / std, -clip, clip).astype(x.dtype)
 
 
 def variance(stats: RunningStats) -> jax.Array:
-    return stats.m2 / stats.count
+    return stats.m2 / _fcount(stats.count)

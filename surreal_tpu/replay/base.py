@@ -50,6 +50,9 @@ def ring_insert(state: RingState, batch: Any, capacity: int) -> RingState:
     N is a static shape; positions are ``(cursor + arange(N)) % capacity``
     — one scatter per leaf, fully on device.
     """
+    from surreal_tpu.utils.asserts import check_insert_batch
+
+    check_insert_batch(batch, state.storage, name="ring_insert")
     n = jax.tree.leaves(batch)[0].shape[0]
     idx = (state.cursor + jnp.arange(n, dtype=jnp.int32)) % capacity
     storage = jax.tree.map(

@@ -1,0 +1,77 @@
+"""Trace-time shape/dtype guards for the learn/insert seams (SURVEY.md
+§5.2: the rebuild replaces the reference's hand-rolled thread-safety with
+SPMD + runtime asserts at the data-plane boundaries).
+
+All checks run at jit trace time (shapes are static), so a wrong-shape
+batch fails HERE with a named message instead of deep inside an XLA
+lowering. Zero runtime cost on device.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import chex
+
+from surreal_tpu.envs.base import DiscreteSpec, EnvSpecs
+
+
+def check_learn_batch(batch: Mapping, specs: EnvSpecs, name: str = "batch") -> None:
+    """Validate a learner batch against the env specs.
+
+    Accepts both layouts the learners use: time-major [T, B, ...] (PPO /
+    IMPALA trajectories) and flat [B, ...] (DDPG n-step transitions) —
+    inferred from the rank of ``reward``.
+    """
+    chex.assert_rank(
+        batch["reward"], {1, 2}, custom_message=f"{name}: reward leading dims"
+    )
+    lead = batch["reward"].shape  # (T, B) or (B,)
+
+    for k in ("obs", "next_obs"):
+        if k in batch:
+            chex.assert_shape(
+                batch[k],
+                (*lead, *specs.obs.shape),
+                custom_message=f"{name}: {k} (obs spec {specs.obs.shape})",
+            )
+    if "action" in batch:
+        if isinstance(specs.action, DiscreteSpec):
+            chex.assert_shape(
+                batch["action"], lead,
+                custom_message=f"{name}: action (discrete -> scalar per step)",
+            )
+        else:
+            chex.assert_shape(
+                batch["action"],
+                (*lead, *specs.action.shape),
+                custom_message=f"{name}: action (spec {specs.action.shape})",
+            )
+    for k in ("done", "terminated", "discount", "behavior_logp", "is_weights"):
+        if k in batch:
+            chex.assert_shape(
+                batch[k], lead, custom_message=f"{name}: {k} must match reward dims"
+            )
+
+
+def check_insert_batch(batch, storage, name: str = "insert") -> None:
+    """Validate a replay-insert batch against the buffer storage: same
+    pytree structure, one shared leading batch dim, per-leaf trailing dims
+    matching the storage's per-transition shapes."""
+    import jax
+
+    b_leaves, b_def = jax.tree_util.tree_flatten_with_path(batch)
+    s_leaves, s_def = jax.tree_util.tree_flatten_with_path(storage)
+    if b_def != s_def:
+        raise ValueError(
+            f"{name}: batch pytree structure does not match replay storage "
+            f"(batch={b_def}, storage={s_def})"
+        )
+    n = b_leaves[0][1].shape[0]
+    for (path, new), (_, buf) in zip(b_leaves, s_leaves):
+        chex.assert_shape(
+            new,
+            (n, *buf.shape[1:]),
+            custom_message=f"{name}: leaf {jax.tree_util.keystr(path)} "
+            f"(storage per-transition shape {buf.shape[1:]})",
+        )

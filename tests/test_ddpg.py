@@ -240,3 +240,40 @@ def test_ddpg_pendulum_improves():
 
     trainer.run(on_metrics=cb)
     assert returns and max(returns) > -400.0, f"returns {returns[-5:]}"
+
+
+def test_offpolicy_host_mode_nstep_end_to_end():
+    """Host-mode OffPolicyTrainer (gym adapter) with n_step>1: runs real
+    updates, finite losses, and the first-chunk fabricated prefix is
+    scrubbed on this path too (review r2: the scrub originally existed
+    only in the device path)."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(
+                name="ddpg",
+                horizon=8,
+                n_step=3,
+                updates_per_iter=2,
+                exploration=Config(warmup_steps=0),
+            ),
+            replay=Config(
+                kind="prioritized", capacity=512, start_sample_size=16, batch_size=32
+            ),
+        ),
+        env_config=Config(name="gym:Pendulum-v1", num_envs=4),
+        session_config=Config(
+            folder="/tmp/test_ddpg_host",
+            total_env_steps=8 * 4 * 5,  # 5 iterations
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = OffPolicyTrainer(cfg)
+    assert not trainer.device_mode
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/critic"])
+    assert np.isfinite(metrics["loss/actor"])
+    assert metrics["time/env_steps"] >= 8 * 4 * 5

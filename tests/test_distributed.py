@@ -68,6 +68,65 @@ def test_param_publisher_server_client_roundtrip():
         pub.close()
 
 
+def test_param_server_multi_bind_serves_every_endpoint():
+    """One REP socket bound to several endpoints serves clients on each
+    (the multi-bind sharding axis the reference's ShardedParameterServer
+    spread over processes)."""
+    from surreal_tpu.distributed import ShardedParameterServer  # noqa: F401
+
+    pub = ParameterPublisher()
+    server = ParameterServer(
+        pub.address, bind=["tcp://127.0.0.1:*", "tcp://127.0.0.1:*"]
+    )
+    clients = []
+    try:
+        assert len(server.addresses) == 2
+        assert server.addresses[0] != server.addresses[1]
+        pub.publish({"w": jnp.full((2,), 3.0)})
+        for addr in server.addresses:
+            c = ParameterClient(addr, template={"w": jnp.zeros(2)})
+            clients.append(c)
+            deadline = time.time() + 5
+            got = None
+            while got is None and time.time() < deadline:
+                got = c.fetch()
+            np.testing.assert_allclose(np.asarray(got["w"]), 3.0)
+    finally:
+        for c in clients:
+            c.close()
+        server.close()
+        pub.close()
+
+
+def test_sharded_param_server_routes_and_serves():
+    """N shards cache the same snapshot; client->shard routing is
+    deterministic and every shard answers."""
+    from surreal_tpu.distributed import ShardedParameterServer
+
+    pub = ParameterPublisher()
+    sharded = ShardedParameterServer(pub.address, num_shards=3)
+    clients = []
+    try:
+        assert len(sharded.addresses) == 3
+        assert sharded.address_for("eval-0") == sharded.address_for("eval-0")
+        routes = {sharded.address_for(f"eval-{i}") for i in range(32)}
+        assert len(routes) > 1  # load actually spreads
+        pub.publish({"w": jnp.full((2,), 9.0)})
+        for addr in sharded.addresses:
+            c = ParameterClient(addr, template={"w": jnp.zeros(2)})
+            clients.append(c)
+            deadline = time.time() + 5
+            got = None
+            while got is None and time.time() < deadline:
+                got = c.fetch()
+            np.testing.assert_allclose(np.asarray(got["w"]), 9.0)
+    finally:
+        for c in clients:
+            c.close()
+        sharded.close()
+        pub.close()
+
+
 def test_seed_inference_server_with_env_workers():
     """Two worker threads stepping gym CartPole against a central batched
     policy; server must emit well-formed time-major trajectory chunks."""
@@ -271,3 +330,129 @@ def test_seed_trainer_max_staleness_drops_old_chunks():
     trainer = SEEDTrainer(cfg, max_staleness=1_000_000)  # never drops
     state, metrics = trainer.run()
     assert metrics["staleness/dropped_chunks"] == 0.0
+
+
+@pytest.mark.slow
+def test_seed_trainer_respawns_killed_worker():
+    """Fault injection (SURVEY.md §5.3): kill an env worker process
+    mid-run; the trainer supervises and respawns it, and training keeps
+    making progress to completion."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder="/tmp/test_seed_respawn",
+            total_env_steps=1500,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(num_env_workers=2),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg, worker_mode="process")
+    killed = {"done": False}
+
+    def cb(it, m):
+        if it >= 2 and not killed["done"]:
+            trainer._workers[0].terminate()  # fault injection
+            trainer._workers[0].join(timeout=5)
+            killed["done"] = True
+        return False
+
+    state, metrics = trainer.run(on_metrics=cb)
+    assert killed["done"]
+    assert metrics["workers/respawns"] >= 1.0
+    assert metrics["time/env_steps"] >= 1500
+
+
+def test_inference_server_drops_partial_chunk_on_worker_respawn():
+    """A respawned worker's obs-only hello on an identity with half-built
+    steps must DROP the partial chunk (review r2: splicing the fresh
+    episode onto the dead worker's steps would hide an episode boundary
+    from GAE/V-trace)."""
+    import pickle
+
+    import zmq
+
+    def act_fn(obs):
+        b = obs.shape[0]
+        return np.zeros(b, np.int64), {"logp": np.zeros(b, np.float32)}
+
+    server = InferenceServer(act_fn=act_fn, unroll_length=4)
+    ctx = zmq.Context.instance()
+
+    def connect(ident):
+        s = ctx.socket(zmq.DEALER)
+        s.setsockopt(zmq.IDENTITY, ident)
+        s.connect(server.address)
+        return s
+
+    def xchg(s, msg):
+        s.send(pickle.dumps(msg, protocol=5))
+        assert s.poll(5000), "server did not reply"
+        return pickle.loads(s.recv())
+
+    obs = np.zeros((2, 3), np.float32)
+    step = {
+        "obs": obs, "reward": np.ones(2, np.float32),
+        "done": np.zeros(2, bool), "truncated": np.zeros(2, bool),
+        "terminal_obs": obs,
+    }
+    try:
+        w1 = connect(b"worker-0")
+        xchg(w1, {"obs": obs})          # hello
+        xchg(w1, dict(step, obs=obs + 1))  # 1 full transition recorded
+        xchg(w1, dict(step, obs=obs + 2))  # 2 recorded
+        w1.close(0)                     # worker dies mid-chunk (unroll=4)
+
+        w2 = connect(b"worker-0")       # respawn, same identity
+        xchg(w2, {"obs": obs + 10})     # obs-only hello must DROP the 2 steps
+        for k in range(4):              # a full fresh chunk
+            xchg(w2, dict(step, obs=obs + 11 + k))
+        chunk = server.chunks.get(timeout=5)
+        # chunk is entirely post-respawn: first obs is the hello obs (10),
+        # not the dead worker's step obs (0/1/2)
+        assert chunk["obs"].shape == (4, 2, 3)
+        np.testing.assert_allclose(chunk["obs"][0], 10.0)
+        assert server.chunks.empty()
+        w2.close(0)
+    finally:
+        server.close()
+
+
+@pytest.mark.slow
+def test_seed_trainer_respawns_sole_worker_while_waiting():
+    """The worst fault case: the ONLY worker dies, so no further chunks can
+    arrive — the supervisor must respawn it from inside the chunk-wait
+    loop (review r2: an after-the-chunk respawn check can never fire
+    here) and the run must still complete."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder="/tmp/test_seed_respawn_sole",
+            total_env_steps=1200,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(num_env_workers=1),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg, worker_mode="process")
+    killed = {"done": False}
+
+    def cb(it, m):
+        if it >= 1 and not killed["done"]:
+            trainer._workers[0].terminate()
+            trainer._workers[0].join(timeout=5)
+            killed["done"] = True
+        return False
+
+    state, metrics = trainer.run(on_metrics=cb)
+    assert killed["done"]
+    assert metrics["workers/respawns"] >= 1.0
+    assert metrics["time/env_steps"] >= 1200

@@ -295,3 +295,127 @@ def test_lift_autoreset_truncates_at_time_limit():
     assert bool(dones[199, 0]) and bool(truncs[199, 0])
     assert not bool(dones[:199].any())
     assert not bool(dones[200, 0])  # fresh episode after auto-reset
+
+
+@pytest.mark.slow
+def test_ppo_learns_on_lift():
+    """The north-star workload actually trains: fused PPO on jax:lift must
+    push episode return well past the no-lift shaping ceiling (~300 for a
+    hoverer that never lifts) within a short CPU-sim budget. On one real
+    TPU chip the same config reaches the full 1000 in under 5 minutes
+    (BASELINE north star: <10 min on a v5e-8)."""
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=64, epochs=4, num_minibatches=4)
+        ),
+        env_config=Config(name="jax:lift", num_envs=256),
+        session_config=Config(
+            folder="/tmp/test_ppo_lift",
+            total_env_steps=5_000_000,
+            metrics=Config(every_n_iters=10, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    best = {"ret": float("-inf")}
+
+    def cb(it, m):
+        r = m.get("episode/return", float("nan"))
+        if r == r:
+            best["ret"] = max(best["ret"], r)
+        return best["ret"] >= 400.0  # early stop: clearly lifting
+
+    Trainer(cfg).run(on_metrics=cb)
+    assert best["ret"] >= 400.0, f"best lift return {best['ret']} < 400"
+
+
+def test_robosuite_adapter_against_faked_module(monkeypatch):
+    """The robosuite backend seam: with a module exposing robosuite's
+    surface (make, dict obs with robot-state/object-state, 4-tuple step,
+    action_spec, horizon) the adapter batches, flattens, rescales actions,
+    and truncates at the horizon. Keeps the `robosuite:` prefix honest
+    without the package installed."""
+    import sys
+    import types
+
+    class FakeSim:
+        def render(self, camera_name, height, width):
+            # bottom-up frame, as MuJoCo offscreen rendering produces
+            frame = np.zeros((height, width, 3), np.uint8)
+            frame[-1, :, 0] = 255  # bottom row red -> top row after flip
+            return frame
+
+    class FakeRobosuiteEnv:
+        horizon = 5
+
+        def __init__(self):
+            self.t = 0
+            self.last_action = None
+            self.sim = FakeSim()
+
+        @property
+        def action_spec(self):
+            return (np.full(3, -0.5, np.float32), np.full(3, 0.5, np.float32))
+
+        def reset(self):
+            self.t = 0
+            return {
+                "robot-state": np.zeros(4, np.float64),
+                "object-state": np.ones(2, np.float64),
+                "camera_image": np.zeros((8, 8, 3)),  # must be filtered out
+            }
+
+        def step(self, action):
+            self.last_action = np.asarray(action)
+            self.t += 1
+            obs = {
+                "robot-state": np.full(4, self.t, np.float64),
+                "object-state": np.ones(2, np.float64),
+                "camera_image": np.zeros((8, 8, 3)),
+            }
+            return obs, 1.5, False, {}
+
+        def close(self):
+            pass
+
+    fake = types.ModuleType("robosuite")
+    fake.make = lambda env_id, **kw: FakeRobosuiteEnv()
+    monkeypatch.setitem(sys.modules, "robosuite", fake)
+
+    env = make_env(env_cfg(name="robosuite:Lift", num_envs=2))
+    # EpisodeStatsWrapper wraps it; specs flow through
+    assert env.specs.obs.shape == (6,)  # 4 + 2, camera filtered
+    assert env.specs.action.shape == (3,)
+    obs = env.reset(seed=0)
+    assert obs.shape == (2, 6)
+    dones = []
+    for _ in range(5):
+        out = env.step(np.array([[1.0, -1.0, 0.0]] * 2))
+        dones.append(out.done.copy())
+    # canonical +-1 rescaled to the env's +-0.5 bounds
+    inner = env.env.envs[0]  # EpisodeStats -> adapter
+    np.testing.assert_allclose(inner.last_action, [0.5, -0.5, 0.0])
+    # horizon=5 -> truncation-done on the 5th step, with terminal_obs
+    assert dones[-1].all() and not np.any(dones[:-1])
+    assert out.info["truncated"].all()
+    np.testing.assert_allclose(out.info["terminal_obs"][0][:4], 5.0)
+    # post-reset obs is the fresh episode's first obs
+    np.testing.assert_allclose(out.obs[0][:4], 0.0)
+    env.close()
+
+    # pixel path: renderable adapter exposes gym-style render(); the
+    # factory-built PixelObsWrapper must produce frames (review r2: the
+    # adapter once hardcoded has_offscreen_renderer=False)
+    penv = make_env(env_cfg(name="robosuite:Lift", num_envs=1, pixel_obs=True))
+    pobs = penv.reset(seed=0)
+    assert pobs.shape == (1, 84, 84, 3) and pobs.dtype == np.uint8
+    assert pobs[0, 0, :, 0].max() == 255  # flipped: red row lands on top
+    penv.close()
+
+
+def test_robosuite_missing_raises_helpful_error():
+    with pytest.raises(ImportError, match="jax:lift"):
+        make_env(env_cfg(name="robosuite:Lift"))

@@ -98,6 +98,125 @@ def test_ppo_early_stop_flag_halts_policy_movement():
     assert float(metrics["policy/early_stopped"]) == 1.0
 
 
+def test_learn_batch_shape_guard_fails_at_seam():
+    """Wrong-shape batches must fail at the learn seam with a chex error,
+    not deep inside an XLA lowering (SURVEY.md §5.2)."""
+    learner = build_learner(
+        Config(algo=Config(name="ppo")), _continuous_specs()
+    )
+    state = learner.init(jax.random.key(0))
+    batch = _fake_batch(jax.random.key(1))
+    batch["action"] = batch["action"][..., :-1]  # act_dim 3 -> 2
+    with pytest.raises(AssertionError):
+        jax.jit(learner.learn)(state, batch, jax.random.key(2))
+
+
+def test_replay_insert_shape_guard_fails_at_seam():
+    from surreal_tpu.replay.base import init_ring, ring_insert
+
+    example = {"obs": jnp.zeros((4,)), "reward": jnp.zeros(())}
+    state = init_ring(example, capacity=16)
+    bad = {"obs": jnp.zeros((8, 3)), "reward": jnp.zeros((8,))}  # obs_dim 3 != 4
+    with pytest.raises(AssertionError):
+        ring_insert(state, bad, capacity=16)
+    with pytest.raises(ValueError):  # structure mismatch: missing key
+        ring_insert(state, {"obs": jnp.zeros((8, 4))}, capacity=16)
+
+
+def test_trainer_run_to_run_determinism():
+    """SURVEY.md §4: fixed-PRNG end-to-end run twice -> identical metrics.
+    Two fresh Trainers with the same seed must produce bitwise-equal losses
+    and episode stats at every metrics sync."""
+
+    def run_once(folder):
+        cfg = Config(
+            learner_config=Config(algo=Config(name="ppo", horizon=16)),
+            env_config=Config(name="jax:cartpole", num_envs=8),
+            session_config=Config(
+                folder=folder,
+                seed=123,
+                total_env_steps=8 * 16 * 6,  # 6 iterations
+                metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+            ),
+        ).extend(base_config())
+        seen = []
+        Trainer(cfg).run(
+            on_metrics=lambda it, m: seen.append(
+                {k: v for k, v in m.items() if not k.startswith("time/")}
+            )
+        )
+        return seen
+
+    a = run_once("/tmp/test_det_a")
+    b = run_once("/tmp/test_det_b")
+    assert len(a) == len(b) and len(a) >= 6
+    for ma, mb in zip(a, b):
+        assert ma.keys() == mb.keys()
+        for k in ma:
+            va, vb = ma[k], mb[k]
+            if np.isnan(va) and np.isnan(vb):
+                continue
+            assert va == vb, f"{k}: {va} != {vb} (run-to-run nondeterminism)"
+
+
+def test_trainer_host_mode_gym_end_to_end():
+    """Host-mode Trainer.run (gym adapter, synchronous host rollout — the
+    path BASELINE config ② uses for dm_control): loss finite, episode
+    stats flow, env steps accounted (VERDICT r1 weak #3)."""
+    cfg = Config(
+        learner_config=Config(algo=Config(name="ppo", horizon=16, epochs=2)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder="/tmp/test_ppo_host",
+            total_env_steps=16 * 4 * 4,  # 4 iterations
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    assert not trainer.device_mode
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/pg"])
+    assert np.isfinite(metrics["loss/value"])
+    assert metrics["time/env_steps"] >= 16 * 4 * 4
+
+
+@pytest.mark.slow
+def test_trainer_host_mode_pixel_cnn_end_to_end():
+    """Config ④ analog: pixel obs (rendered, resized, grayscale,
+    frame-stacked) through the Nature-CNN PPO — two host-mode iterations
+    run and produce finite losses."""
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=8, epochs=1, num_minibatches=1),
+            model=Config(cnn=Config(enabled=True, dense=64)),
+        ),
+        env_config=Config(
+            name="gym:CartPole-v1",
+            num_envs=2,
+            pixel_obs=True,
+            grayscale=True,
+            frame_stack=4,
+            image_size=(84, 84),
+        ),
+        session_config=Config(
+            folder="/tmp/test_ppo_pixel",
+            total_env_steps=8 * 2 * 2,  # 2 iterations
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    assert trainer.env.specs.obs.shape == (84, 84, 4)
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/pg"])
+    assert np.isfinite(metrics["loss/value"])
+
+
 @pytest.mark.slow
 def test_ppo_cartpole_reaches_475():
     cfg = Config(

@@ -253,3 +253,35 @@ def test_cli_selects_trainer_by_algo_and_env():
         algo, env, folder = "ppo", "jax:cartpole", "/tmp/sel2"
 
     assert isinstance(select_trainer(build_config(B)), Trainer)
+
+
+def test_evaluator_records_video(tmp_path):
+    """Eval is where the reference recorded videos (run_eval +
+    VideoWrapper); the host evaluator must actually produce an episode
+    recording when env_config.video is enabled."""
+    import os
+
+    from surreal_tpu.envs.base import DiscreteSpec
+    from surreal_tpu.launch.evaluator import Evaluator
+    from surreal_tpu.session.default_configs import BASE_ENV_CONFIG
+
+    vdir = str(tmp_path / "videos")
+    env_cfg = Config(
+        name="gym:CartPole-v1",
+        num_envs=1,
+        video=Config(enabled=True, dir=vdir, every_n_episodes=1),
+    ).extend(BASE_ENV_CONFIG)
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(4,), dtype=np.dtype(np.float32)),
+        action=DiscreteSpec(shape=(), dtype=np.dtype(np.int32), n=2),
+    )
+    learner = build_learner(Config(algo=Config(name="ppo")), specs)
+    state = learner.init(jax.random.key(0))
+    ev = Evaluator(env_cfg, Config(episodes=1, mode="deterministic"), learner)
+    try:
+        out = ev.evaluate(state, jax.random.key(1))
+        assert np.isfinite(out["eval/return"])
+        files = os.listdir(vdir)
+        assert any(f.startswith("episode_") for f in files), files
+    finally:
+        ev.close()
