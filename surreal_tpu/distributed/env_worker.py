@@ -60,6 +60,11 @@ def run_env_worker(
                 env.close()
                 return steps
         else:
+            # release the env + socket before dying: the supervisor will
+            # respawn this worker, and leaked same-identity DEALER sockets
+            # are exactly the stale connections ROUTER_HANDOVER must fight
+            sock.close(0)
+            env.close()
             raise TimeoutError(f"worker {worker_id}: inference server silent for 120s")
         actions = pickle.loads(sock.recv())
         out = env.step(actions)

@@ -48,6 +48,12 @@ def _builtin_jax_envs():
         _JAX_ENVS.setdefault("lift", BlockLift)
     except ImportError:
         pass
+    try:
+        from surreal_tpu.envs.jax.pong import Pong
+
+        _JAX_ENVS.setdefault("pong", Pong)
+    except ImportError:
+        pass
 
 
 def is_jax_env(env: AnyEnv) -> bool:
