@@ -41,41 +41,45 @@ def run_env_worker(
     sock.setsockopt(zmq.IDENTITY, f"worker-{worker_id}".encode())
     sock.connect(server_address)
 
-    obs = env.reset(seed=env_config.seed + worker_id)
-    msg: dict = {"obs": obs}
-    steps = 0
-    while (max_steps is None or steps < max_steps) and not (
-        stop_event is not None and stop_event.is_set()
-    ):
-        sock.send(pickle.dumps(msg, protocol=5))
-        # poll in short slices so a stop request (set while we wait on a
-        # server that already shut down) exits cleanly instead of raising.
-        # The budget is generous because the server's first replies wait on
-        # XLA compiles (tens of seconds on a tunneled TPU).
-        for _ in range(1200):
-            if sock.poll(100):
-                break
-            if stop_event is not None and stop_event.is_set():
-                sock.close(0)
-                env.close()
-                return steps
-        else:
-            # release the env + socket before dying: the supervisor will
-            # respawn this worker, and leaked same-identity DEALER sockets
-            # are exactly the stale connections ROUTER_HANDOVER must fight
-            sock.close(0)
-            env.close()
-            raise TimeoutError(f"worker {worker_id}: inference server silent for 120s")
-        actions = pickle.loads(sock.recv())
-        out = env.step(actions)
-        steps += env.num_envs
-        msg = {
-            "obs": out.obs,
-            "reward": out.reward,
-            "done": out.done,
-            "truncated": np.asarray(out.info.get("truncated", np.zeros_like(out.done))),
-            "terminal_obs": out.info.get("terminal_obs", out.obs),
-        }
-    sock.close(0)
-    env.close()
-    return steps
+    # every exit — stop request, timeout, env/pickle exception, normal end —
+    # must release the env and the DEALER socket: the supervisor respawns
+    # workers under the SAME identity, and a leaked socket is exactly the
+    # stale connection ROUTER_HANDOVER then has to displace
+    try:
+        obs = env.reset(seed=env_config.seed + worker_id)
+        msg: dict = {"obs": obs}
+        steps = 0
+        while (max_steps is None or steps < max_steps) and not (
+            stop_event is not None and stop_event.is_set()
+        ):
+            sock.send(pickle.dumps(msg, protocol=5))
+            # poll in short slices so a stop request (set while we wait on
+            # a server that already shut down) exits cleanly instead of
+            # raising. The budget is generous because the server's first
+            # replies wait on XLA compiles (tens of seconds on a tunneled
+            # TPU).
+            for _ in range(1200):
+                if sock.poll(100):
+                    break
+                if stop_event is not None and stop_event.is_set():
+                    return steps
+            else:
+                raise TimeoutError(
+                    f"worker {worker_id}: inference server silent for 120s"
+                )
+            actions = pickle.loads(sock.recv())
+            out = env.step(actions)
+            steps += env.num_envs
+            msg = {
+                "obs": out.obs,
+                "reward": out.reward,
+                "done": out.done,
+                "truncated": np.asarray(
+                    out.info.get("truncated", np.zeros_like(out.done))
+                ),
+                "terminal_obs": out.info.get("terminal_obs", out.obs),
+            }
+        return steps
+    finally:
+        sock.close(0)
+        env.close()

@@ -131,7 +131,13 @@ class Pong(JaxEnv):
             offset = (ball[1] - paddle_y) / _PADDLE_HALF  # [-1, 1]
             speed = jnp.minimum(jnp.abs(vel[0]) * _SPEEDUP, _BALL_SPEED_MAX)
             new_vx = speed if left else -speed
-            new_vel = jnp.stack([new_vx, vel[1] + offset * _DEFLECT])
+            # vy capped like vx: without the clamp, deflections random-walk
+            # |vy| up within a rally, and the opponent's beatability rests
+            # on its tracking speed staying below this cap
+            new_vy = jnp.clip(
+                vel[1] + offset * _DEFLECT, -_BALL_SPEED_MAX, _BALL_SPEED_MAX
+            )
+            new_vel = jnp.stack([new_vx, new_vy])
             vel = jnp.where(hit, new_vel, vel)
             ball = jnp.where(hit, ball.at[0].set(plane_x), ball)
             return ball, vel, hit, crossed

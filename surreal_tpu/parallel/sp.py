@@ -1,0 +1,63 @@
+"""Sequence parallelism over the trajectory/time axis.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.4/§5.7:
+no attention models anywhere — its "sequence" machinery is trajectory
+windowing). The TPU rebuild's equivalent of long-context scaling is the
+trajectory HORIZON: returns/advantages are first-order linear recurrences
+over time, which compose associatively, so a horizon too long for one
+device's HBM (or one scan's latency) shards over a mesh axis and the
+associative scan runs in O(log T) depth with XLA inserting the cross-shard
+collectives — the same pick-a-mesh / annotate-shardings / let-XLA-insert-
+collectives recipe as the dp path (SURVEY.md §5.8).
+
+This module is that seam made concrete: GAE with the time axis sharded
+over an ``sp`` mesh axis via GSPMD (``NamedSharding`` on T). It is exact —
+bitwise-equivalent math to ``ops.returns.gae_advantages_assoc``, just
+distributed — and composes with a batch (dp) axis on dim 1.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from surreal_tpu.ops.returns import gae_advantages_assoc
+
+
+@functools.partial(jax.jit, static_argnames="lam")
+def _gae_assoc_jit(r, d, v, boot, lam):
+    # module-level jit: a closure re-created per call would miss the jit
+    # cache and retrace every invocation
+    v_stack = jnp.concatenate([v, boot[None]], axis=0)  # [T+1, ...]
+    return gae_advantages_assoc(r, d, v_stack, lam)
+
+
+def gae_sequence_parallel(
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    lam: float,
+    mesh: Mesh,
+    axis: str = "sp",
+):
+    """GAE with the TIME axis sharded over ``mesh[axis]``.
+
+    Args:
+      rewards, discounts, values: [T, ...] time-major (values[t] = V(s_t)).
+      bootstrap_value: [...] value of the state after the last step.
+      lam: GAE lambda.
+      mesh: mesh containing the ``axis`` to shard T over.
+
+    Returns (advantages [T, ...], value_targets [T, ...]), sharded along T.
+    """
+    t_spec = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    rewards = jax.device_put(rewards, t_spec)
+    discounts = jax.device_put(discounts, t_spec)
+    values = jax.device_put(values, t_spec)
+    bootstrap_value = jax.device_put(bootstrap_value, rep)
+    return _gae_assoc_jit(rewards, discounts, values, bootstrap_value, lam)

@@ -497,3 +497,17 @@ def test_impala_cnn_trains_on_pong():
     state, metrics = trainer.run()
     assert np.isfinite(metrics["loss/pg"])
     assert np.isfinite(metrics["loss/value"])
+
+
+def test_dm_control_adapter_batched_cheetah():
+    """Config ② backend: dm_control cheetah-run through the batched host
+    adapter — flattened obs vector, canonical [-1,1] actions, time-limit
+    truncation flagged (dm_control episodes end by time limit)."""
+    env = make_env(env_cfg(name="dm_control:cheetah-run", num_envs=2))
+    obs = env.reset()
+    assert obs.ndim == 2 and obs.shape[0] == 2
+    out = env.step(np.ones((2, *env.specs.action.shape), np.float32))
+    assert out.obs.shape == obs.shape
+    assert out.reward.shape == (2,)
+    assert not out.done.any()  # cheetah runs 1000 steps before the limit
+    assert np.isfinite(out.obs).all()

@@ -285,3 +285,27 @@ def test_evaluator_records_video(tmp_path):
         assert any(f.startswith("episode_") for f in files), files
     finally:
         ev.close()
+
+
+def test_profiler_trace_window_writes_profile(tmp_path):
+    """SURVEY §5.1: the session-config profiler hook must capture a
+    jax.profiler trace window around the configured iterations and leave
+    the TensorBoard profile artifacts under <folder>/profile."""
+    from surreal_tpu.launch.trainer import Trainer
+
+    folder = str(tmp_path / "prof_run")
+    cfg = Config(
+        learner_config=Config(algo=Config(name="ppo", horizon=8)),
+        env_config=Config(name="jax:cartpole", num_envs=8),
+        session_config=Config(
+            folder=folder,
+            total_env_steps=8 * 8 * 6,  # 6 iterations
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            profiler=Config(enabled=True, start_iter=2, num_iters=2),
+        ),
+    ).extend(base_config())
+    Trainer(cfg).run()
+    trace_files = glob.glob(os.path.join(folder, "profile", "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in trace_files), trace_files
