@@ -36,16 +36,17 @@ def run_env_worker(
 
     env_config = Config(env_config)
     env = make_env(env_config)
-    ctx = zmq.Context.instance()
-    sock = ctx.socket(zmq.DEALER)
-    sock.setsockopt(zmq.IDENTITY, f"worker-{worker_id}".encode())
-    sock.connect(server_address)
-
-    # every exit — stop request, timeout, env/pickle exception, normal end —
-    # must release the env and the DEALER socket: the supervisor respawns
-    # workers under the SAME identity, and a leaked socket is exactly the
-    # stale connection ROUTER_HANDOVER then has to displace
+    # every exit — stop request, timeout, socket-setup or env/pickle
+    # exception, normal end — must release the env and the DEALER socket:
+    # the supervisor respawns workers under the SAME identity, and a leaked
+    # socket is exactly the stale connection ROUTER_HANDOVER must displace
+    sock = None
     try:
+        ctx = zmq.Context.instance()
+        sock = ctx.socket(zmq.DEALER)
+        sock.setsockopt(zmq.IDENTITY, f"worker-{worker_id}".encode())
+        sock.connect(server_address)
+
         obs = env.reset(seed=env_config.seed + worker_id)
         msg: dict = {"obs": obs}
         steps = 0
@@ -81,5 +82,6 @@ def run_env_worker(
             }
         return steps
     finally:
-        sock.close(0)
+        if sock is not None:
+            sock.close(0)
         env.close()

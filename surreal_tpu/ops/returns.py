@@ -56,29 +56,34 @@ def gae_advantages(
     return advantages, advantages + values[:-1]
 
 
-def gae_advantages_assoc(
-    rewards: jax.Array,
-    discounts: jax.Array,
-    values: jax.Array,
-    lam: float,
-) -> tuple[jax.Array, jax.Array]:
-    """GAE via ``associative_scan`` — O(log T) depth for long horizons.
+def reverse_linear_scan_assoc(coeffs: jax.Array, deltas: jax.Array) -> jax.Array:
+    """Solve ``x_t = deltas_t + coeffs_t * x_{t+1}`` (x_T = 0) in O(log T)
+    depth via ``associative_scan``: over reversed time the recurrence
+    composes associatively as (c, d)∘(c', d') = (c*c', d' + c'*d).
 
-    The recurrence A_t = delta_t + c_t * A_{t+1} is a first-order linear
-    recurrence; over reversed time it composes associatively as
-    (c, d)∘(c', d') = (c*c', d' + c'*d) applied left-to-right.
+    This is THE recurrence of return estimation — GAE, V-trace, and
+    discounted returns are all instances — and, being an associative scan,
+    it also shards over a sequence-parallel mesh axis (parallel/sp.py).
     """
-    deltas = rewards + discounts * values[1:] - values[:-1]
-    decay = discounts * lam
 
     def combine(left, right):
         c_l, d_l = left
         c_r, d_r = right
         return c_l * c_r, d_r + c_r * d_l
 
-    c_rev, a_rev = lax.associative_scan(combine, (decay[::-1], deltas[::-1]))
-    del c_rev
-    advantages = a_rev[::-1]
+    _, x_rev = lax.associative_scan(combine, (coeffs[::-1], deltas[::-1]))
+    return x_rev[::-1]
+
+
+def gae_advantages_assoc(
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    lam: float,
+) -> tuple[jax.Array, jax.Array]:
+    """GAE via ``associative_scan`` — O(log T) depth for long horizons."""
+    deltas = rewards + discounts * values[1:] - values[:-1]
+    advantages = reverse_linear_scan_assoc(discounts * lam, deltas)
     return advantages, advantages + values[:-1]
 
 

@@ -20,6 +20,14 @@ class VTraceOutput(NamedTuple):
     pg_advantages: jax.Array  # [T, ...] policy-gradient advantages
 
 
+def _pg_advantages(rhos, clip_pg_rho, rewards, discounts, vs, values):
+    """Shared pg-advantage tail: q_t = r_t + gamma_t * vs_{t+1}, final step
+    bootstrapped with V_T (``values`` is the [T+1] stack)."""
+    vs_next = jnp.concatenate([vs[1:], values[-1:]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    return clipped_pg_rhos * (rewards + discounts * vs_next - values[:-1])
+
+
 def vtrace(
     behaviour_logp: jax.Array,
     target_logp: jax.Array,
@@ -59,12 +67,41 @@ def vtrace(
     vs_minus_v = acc_rev[::-1]
     vs = vs_minus_v + values[:-1]
 
-    # pg advantage uses vs_{t+1}, bootstrapping the final step with V_T.
-    vs_next = jnp.concatenate([vs[1:], values[-1:]], axis=0)
-    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
-    pg_advantages = clipped_pg_rhos * (rewards + discounts * vs_next - values[:-1])
-
+    pg_advantages = _pg_advantages(rhos, clip_pg_rho, rewards, discounts, vs, values)
     return VTraceOutput(vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages))
+
+
+def vtrace_assoc(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+    clip_pg_rho: float = 1.0,
+) -> VTraceOutput:
+    """:func:`vtrace` via ``associative_scan`` — O(log T) depth.
+
+    The recursion ``x_t = delta_t + (gamma_t c_t) x_{t+1}`` is the same
+    first-order linear recurrence as GAE's (shared solver:
+    ``ops.returns.reverse_linear_scan_assoc``), so it also shards over a
+    sequence-parallel mesh axis (parallel/sp.py).
+    """
+    from surreal_tpu.ops.returns import reverse_linear_scan_assoc
+
+    log_rhos = target_logp - behaviour_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+
+    deltas = clipped_rhos * (rewards + discounts * values[1:] - values[:-1])
+    vs = reverse_linear_scan_assoc(discounts * cs, deltas) + values[:-1]
+
+    pg_advantages = _pg_advantages(rhos, clip_pg_rho, rewards, discounts, vs, values)
+    return VTraceOutput(
+        vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages)
+    )
 
 
 def vtrace_nextobs(

@@ -61,3 +61,34 @@ def gae_sequence_parallel(
     values = jax.device_put(values, t_spec)
     bootstrap_value = jax.device_put(bootstrap_value, rep)
     return _gae_assoc_jit(rewards, discounts, values, bootstrap_value, lam)
+
+
+@jax.jit
+def _vtrace_assoc_jit(blogp, tlogp, r, d, v, boot):
+    from surreal_tpu.ops.vtrace import vtrace_assoc
+
+    v_stack = jnp.concatenate([v, boot[None]], axis=0)  # [T+1, ...]
+    return vtrace_assoc(blogp, tlogp, r, d, v_stack)
+
+
+def vtrace_sequence_parallel(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    bootstrap_value: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+):
+    """V-trace with the TIME axis sharded over ``mesh[axis]`` — same
+    recurrence family as GAE (see :func:`gae_sequence_parallel`), so the
+    same GSPMD treatment applies. All [T, ...] args shard along T."""
+    t_spec = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    args = [
+        jax.device_put(x, t_spec)
+        for x in (behaviour_logp, target_logp, rewards, discounts, values)
+    ]
+    boot = jax.device_put(bootstrap_value, rep)
+    return _vtrace_assoc_jit(*args, boot)

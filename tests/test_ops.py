@@ -296,3 +296,24 @@ def test_running_stats_3d_batch():
     stats = RS.update_stats(RS.init_stats((3,)), jnp.asarray(data))
     np.testing.assert_allclose(stats.mean, data.reshape(-1, 3).mean(0), rtol=1e-3, atol=1e-3)
     assert float(stats.count) == pytest.approx(80, rel=1e-3)
+
+
+def test_vtrace_assoc_matches_scan():
+    """The associative-scan V-trace must match the reverse-scan reference
+    on trajectories with episode boundaries (discounts=0 rows)."""
+    from surreal_tpu.ops.vtrace import vtrace, vtrace_assoc
+
+    rng = np.random.default_rng(11)
+    T, B = 64, 4
+    blogp = jnp.asarray(rng.normal(scale=0.3, size=(T, B)), jnp.float32)
+    tlogp = blogp + jnp.asarray(rng.normal(scale=0.2, size=(T, B)), jnp.float32)
+    rewards = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+    done = jnp.asarray(rng.random((T, B)) < 0.05)
+    discounts = 0.99 * (1.0 - done.astype(jnp.float32))
+    values = jnp.asarray(rng.normal(size=(T + 1, B)), jnp.float32)
+    a = vtrace(blogp, tlogp, rewards, discounts, values)
+    b = vtrace_assoc(blogp, tlogp, rewards, discounts, values)
+    np.testing.assert_allclose(np.asarray(b.vs), np.asarray(a.vs), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(b.pg_advantages), np.asarray(a.pg_advantages), rtol=2e-4, atol=2e-4
+    )

@@ -233,3 +233,35 @@ def test_gae_sequence_parallel_matches_single_device():
     # device): its sharding spec names the axis on dim 0
     spec = adv_sp.sharding.spec
     assert spec and spec[0] == "sp", spec
+
+
+def test_vtrace_sequence_parallel_matches_single_device():
+    """V-trace shards over the sp axis exactly like GAE (same linear
+    recurrence family)."""
+    from jax.sharding import Mesh
+
+    from surreal_tpu.ops.vtrace import vtrace
+    from surreal_tpu.parallel.sp import vtrace_sequence_parallel
+
+    T, B = 2048, 2
+    rng = np.random.default_rng(3)
+    blogp = jnp.asarray(rng.normal(scale=0.3, size=(T, B)), jnp.float32)
+    tlogp = blogp + jnp.asarray(rng.normal(scale=0.2, size=(T, B)), jnp.float32)
+    rewards = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+    done = jnp.asarray(rng.random((T, B)) < 0.02)
+    discounts = 0.99 * (1.0 - done.astype(jnp.float32))
+    values = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+    boot = jnp.asarray(rng.normal(size=(B,)), jnp.float32)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(8), ("sp",))
+    out_sp = vtrace_sequence_parallel(
+        blogp, tlogp, rewards, discounts, values, boot, mesh
+    )
+    v_stack = jnp.concatenate([values, boot[None]], axis=0)
+    ref = vtrace(blogp, tlogp, rewards, discounts, v_stack)
+    np.testing.assert_allclose(np.asarray(out_sp.vs), np.asarray(ref.vs), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(out_sp.pg_advantages), np.asarray(ref.pg_advantages),
+        rtol=2e-4, atol=2e-4,
+    )
+    assert out_sp.vs.sharding.spec[0] == "sp"
