@@ -45,6 +45,15 @@ class SEEDTrainer:
     ):
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode {worker_mode!r} not in thread|process")
+        algo_name = config.learner_config.algo.name
+        if algo_name == "ddpg":
+            # the server stitches chunks from behavior-policy info (logp);
+            # DDPG's deterministic actor has none — its disaggregated
+            # topology is OffPolicyTrainer's host mode (replay-driven)
+            raise ValueError(
+                "SEEDTrainer supports on-policy learners (ppo, impala); "
+                "for ddpg use OffPolicyTrainer (host mode)"
+            )
         self.config = config
         from surreal_tpu.envs import make_env
 

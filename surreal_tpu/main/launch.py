@@ -54,6 +54,8 @@ def build_config(args) -> Config:
         overrides.session_config.total_env_steps = args.total_steps
     if args.restore_from is not None:
         overrides.session_config.checkpoint = Config(restore_from=args.restore_from)
+    if getattr(args, "workers", None) is not None:
+        overrides.session_config.topology = Config(num_env_workers=args.workers)
     if args.set:
         overrides.override_from_dotlist(args.set)
     return overrides.extend(base_config())
@@ -71,11 +73,21 @@ def select_trainer(config):
     algo = config.learner_config.algo.name
     env_name = config.env_config.name
     workers = config.session_config.topology.num_env_workers
+    if workers > 0 and (algo == "ddpg" or env_name.startswith("jax:")):
+        # fail loudly rather than silently running a different topology
+        # than the one the user configured
+        raise ValueError(
+            f"topology.num_env_workers={workers} selects the SEED "
+            "inference-server topology, which needs a HOST env (gym:/"
+            "dm_control:/robosuite:) and an on-policy algo (ppo, impala); "
+            f"got algo={algo!r}, env={env_name!r} — drop --workers, or "
+            "use a host env / on-policy algo"
+        )
     if algo == "ddpg":
         from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
 
         return OffPolicyTrainer(config)
-    if not env_name.startswith("jax:") and workers > 0:
+    if workers > 0:
         from surreal_tpu.launch.seed_trainer import SEEDTrainer
 
         return SEEDTrainer(config)
@@ -154,6 +166,9 @@ def main(argv=None) -> int:
     t.add_argument("--total-steps", type=int, default=None)
     t.add_argument("--restore-from", default=None,
                    help="foreign session folder to warm-start from")
+    t.add_argument("--workers", type=int, default=None,
+                   help="env-worker processes/threads for host envs (>0 "
+                        "selects the SEED inference-server topology)")
     t.add_argument("--set", nargs="*", metavar="KEY=VAL", default=[],
                    help="dotlist overrides, e.g. learner_config.algo.horizon=64")
     t.set_defaults(fn=run_train)

@@ -456,3 +456,46 @@ def test_seed_trainer_respawns_sole_worker_while_waiting():
     assert killed["done"]
     assert metrics["workers/respawns"] >= 1.0
     assert metrics["time/env_steps"] >= 1200
+
+
+@pytest.mark.slow
+def test_seed_trainer_ppo_with_staleness_guard():
+    """PPO over SEED — the reference's own topology (disaggregated PPO
+    actors): behavior info flows through chunks, max_staleness bounds how
+    old a window's acting policy may be, and training proceeds."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="ppo", horizon=8, epochs=2,
+                                          num_minibatches=1)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder="/tmp/test_seed_ppo",
+            total_env_steps=600,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(num_env_workers=2),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg, max_staleness=3)
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/pg"])
+    assert np.isfinite(metrics["loss/value"])
+    # drop behavior under a tight max_staleness is covered by
+    # test_seed_trainer_max_staleness_drops_old_chunks; here the counter
+    # must exist and training must complete with the guard active
+    assert metrics["staleness/dropped_chunks"] >= 0.0
+    assert metrics["time/env_steps"] >= 600
+
+
+def test_seed_trainer_rejects_ddpg():
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="ddpg")),
+        env_config=Config(name="gym:Pendulum-v1", num_envs=2),
+        session_config=Config(folder="/tmp/test_seed_reject"),
+    ).extend(base_config())
+    with pytest.raises(ValueError, match="OffPolicyTrainer"):
+        SEEDTrainer(cfg)

@@ -309,3 +309,28 @@ def test_profiler_trace_window_writes_profile(tmp_path):
     Trainer(cfg).run()
     trace_files = glob.glob(os.path.join(folder, "profile", "**", "*"), recursive=True)
     assert any(os.path.isfile(f) for f in trace_files), trace_files
+
+
+def test_cli_rejects_workers_for_incompatible_topology():
+    """--workers (num_env_workers>0) with a jax env or ddpg must fail
+    loudly instead of silently running a different topology."""
+    from surreal_tpu.main.launch import select_trainer
+
+    bad = Config(
+        learner_config=Config(algo=Config(name="ppo")),
+        env_config=Config(name="jax:cartpole", num_envs=8),
+        session_config=Config(
+            folder="/tmp/x", topology=Config(num_env_workers=4)
+        ),
+    ).extend(base_config())
+    with pytest.raises(ValueError, match="HOST env"):
+        select_trainer(bad)
+    bad2 = Config(
+        learner_config=Config(algo=Config(name="ddpg")),
+        env_config=Config(name="gym:Pendulum-v1", num_envs=2),
+        session_config=Config(
+            folder="/tmp/x", topology=Config(num_env_workers=4)
+        ),
+    ).extend(base_config())
+    with pytest.raises(ValueError, match="on-policy"):
+        select_trainer(bad2)
