@@ -84,14 +84,11 @@ class OffPolicyTrainer:
                 # reference's ShardedReplay role, replay/sharded.py) +
                 # gradient pmean inside learner.learn
                 from surreal_tpu.parallel.dp import dp_offpolicy_iter
+                from surreal_tpu.parallel.mesh import check_dp_divisible
                 from surreal_tpu.replay.sharded import scale_replay_config
 
                 dp = self.mesh.shape["dp"]
-                if self.num_envs % dp != 0:
-                    raise ValueError(
-                        f"num_envs={self.num_envs} must be divisible by the "
-                        f"dp axis size {dp}"
-                    )
+                check_dp_divisible(self.num_envs, dp)
                 self.replay = build_replay(
                     scale_replay_config(self.learner.config.replay, dp)
                 )
@@ -284,11 +281,9 @@ class OffPolicyTrainer:
                     total, on_metrics, hooks, state, iteration, env_steps
                 )
             if self.mesh is not None and self.mesh.size > 1:
-                from jax.sharding import NamedSharding, PartitionSpec
+                from surreal_tpu.parallel.mesh import replicate_state
 
-                state = jax.device_put(
-                    state, NamedSharding(self.mesh, PartitionSpec())
-                )
+                state = replicate_state(self.mesh, state)
             keys = jax.random.split(env_key, self.num_envs)
             env_state, obs = jax.vmap(self.env.reset)(keys)
             n = self.algo.n_step

@@ -68,7 +68,35 @@ class SEEDTrainer:
         self.max_staleness = max_staleness
 
         self._jit_act = jax.jit(self.learner.act, static_argnames="mode")
-        self._learn = jax.jit(self.learner.learn)
+        # multi-chip learner: an EXPLICIT dp axis (topology.mesh.dp > 1;
+        # the -1 "use everything" default stays single-device here because
+        # SEED batch width is set by num_envs, which must divide dp) runs
+        # learn under shard_map with gradient psum — same dp_learn as the
+        # fused trainers; acting stays one forward over replicated params.
+        self.mesh = None
+        dp = int(config.session_config.topology.mesh.dp)
+        if dp > 1:
+            from surreal_tpu.parallel.dp import dp_learn
+            from surreal_tpu.parallel.mesh import check_dp_divisible, make_mesh
+
+            check_dp_divisible(
+                config.env_config.num_envs, dp, what="env_config.num_envs"
+            )
+            tp = max(1, int(config.session_config.topology.mesh.tp))
+            if dp * tp > jax.device_count():
+                raise ValueError(
+                    f"topology.mesh dp={dp} tp={tp} asks for {dp * tp} "
+                    f"devices but only {jax.device_count()} exist"
+                )
+            # an explicit dp may use a SUBSET of devices (the rest serve
+            # inference/other work); make_mesh itself demands all devices
+            self.mesh = make_mesh(
+                config.session_config.topology,
+                devices=jax.devices()[: dp * tp],
+            )
+            self._learn = dp_learn(self.learner, self.mesh)
+        else:
+            self._learn = jax.jit(self.learner.learn)
 
     def _spawn_one(self, i: int, env_cfg, address, stop):
         """Start env worker ``i`` as a thread or subprocess.
@@ -159,6 +187,10 @@ class SEEDTrainer:
         stop = threading.Event()
         try:
             state, iteration, env_steps = hooks.restore(state)
+            if self.mesh is not None:
+                from surreal_tpu.parallel.mesh import replicate_state
+
+                state = replicate_state(self.mesh, state)
             hooks.begin_run(iteration, env_steps)
             key_holder = [act_key]
             server = InferenceServer(
@@ -208,7 +240,17 @@ class SEEDTrainer:
                 if self.max_staleness is not None and staleness > self.max_staleness:
                     dropped_stale += 1
                     continue  # acted by a too-old policy: drop, don't train
-                batch = jax.device_put(chunk)
+                if self.mesh is not None:
+                    # split host->devices directly along the dp-sharded
+                    # batch dim; a plain device_put would commit the whole
+                    # chunk to device 0 and reshard inside the jit
+                    from surreal_tpu.parallel.mesh import batch_sharded
+
+                    batch = jax.device_put(
+                        chunk, batch_sharded(self.mesh, batch_dim=1)
+                    )
+                else:
+                    batch = jax.device_put(chunk)
                 key, lkey, hk_key = jax.random.split(key, 3)
                 state, metrics = self._learn(state, batch, lkey)
                 server.set_act_fn(self._make_act_fn(state, key_holder))

@@ -55,12 +55,9 @@ class Trainer:
             self.mesh = make_mesh(topo)
             if self.mesh.size > 1:
                 from surreal_tpu.parallel.dp import dp_train_iter
+                from surreal_tpu.parallel.mesh import check_dp_divisible
 
-                if self.num_envs % self.mesh.shape["dp"] != 0:
-                    raise ValueError(
-                        f"num_envs={self.num_envs} must be divisible by the "
-                        f"dp axis size {self.mesh.shape['dp']}"
-                    )
+                check_dp_divisible(self.num_envs, self.mesh.shape["dp"])
                 self._train_iter = dp_train_iter(
                     self._device_train_iter, self.learner, self.mesh
                 )
@@ -129,11 +126,9 @@ class Trainer:
             if self.mesh is not None and self.mesh.size > 1:
                 # restored checkpoints come back committed to one device;
                 # the dp shard_map needs the state replicated over the mesh
-                from jax.sharding import NamedSharding, PartitionSpec
+                from surreal_tpu.parallel.mesh import replicate_state
 
-                state = jax.device_put(
-                    state, NamedSharding(self.mesh, PartitionSpec())
-                )
+                state = replicate_state(self.mesh, state)
             hooks.begin_run(iteration, env_steps)
 
             if self.device_mode:

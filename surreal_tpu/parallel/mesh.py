@@ -48,6 +48,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def check_dp_divisible(num: int, dp: int, what: str = "num_envs") -> None:
+    """Shared dp-batch guard: every dp trainer shards a batch width over
+    the ``dp`` axis and must reject indivisible configs identically."""
+    if num % dp != 0:
+        raise ValueError(
+            f"{what}={num} must be divisible by the dp axis size {dp}"
+        )
+
+
+def replicate_state(mesh: Mesh, state):
+    """Commit a (possibly single-device, e.g. just-restored) state pytree
+    as replicated over the mesh — required before any shard_map step."""
+    import jax
+
+    return jax.device_put(state, replicated(mesh))
+
+
 def batch_sharded(mesh: Mesh, axis: str = "dp", batch_dim: int = 0) -> NamedSharding:
     spec = [None] * (batch_dim + 1)
     spec[batch_dim] = axis

@@ -265,3 +265,47 @@ def test_vtrace_sequence_parallel_matches_single_device():
         rtol=2e-4, atol=2e-4,
     )
     assert out_sp.vs.sharding.spec[0] == "sp"
+
+
+@pytest.mark.slow
+def test_seed_trainer_dp_learner_on_mesh():
+    """SEED topology with a multi-chip learner: an explicit dp axis runs
+    learn under shard_map (grad psum) while the inference server keeps
+    serving; one short run completes with finite losses."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=8),
+        session_config=Config(
+            folder="/tmp/test_seed_dp",
+            total_env_steps=600,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(num_env_workers=2, mesh=Config(dp=4, tp=1)),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg)
+    assert trainer.mesh is not None and trainer.mesh.shape["dp"] == 4
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/pg"])
+    assert np.isfinite(metrics["loss/value"])
+    assert metrics["time/env_steps"] >= 600
+
+
+def test_seed_trainer_dp_requires_divisible_envs():
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala")),
+        env_config=Config(name="gym:CartPole-v1", num_envs=6),
+        session_config=Config(
+            folder="/tmp/test_seed_dp_bad",
+            topology=Config(mesh=Config(dp=4, tp=1)),
+        ),
+    ).extend(base_config())
+    with pytest.raises(ValueError, match="divisible"):
+        SEEDTrainer(cfg)
