@@ -317,3 +317,24 @@ def test_vtrace_assoc_matches_scan():
     np.testing.assert_allclose(
         np.asarray(b.pg_advantages), np.asarray(a.pg_advantages), rtol=2e-4, atol=2e-4
     )
+
+
+def test_gae_pallas_matches_scan():
+    """The fused Pallas GAE kernel (interpret mode off-TPU) must match the
+    reverse-scan reference, including episode boundaries and non-multiple-
+    of-128 batch widths (padding path)."""
+    from surreal_tpu.ops.pallas_gae import gae_advantages_pallas
+
+    rng = np.random.default_rng(12)
+    for B in (128, 200):  # aligned and padded widths
+        T = 40
+        rewards = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+        done = jnp.asarray(rng.random((T, B)) < 0.1)
+        discounts = 0.99 * (1.0 - done.astype(jnp.float32))
+        values = jnp.asarray(rng.normal(size=(T + 1, B)), jnp.float32)
+        adv_p, tgt_p = gae_advantages_pallas(
+            rewards, discounts, values, 0.95, interpret=True
+        )
+        adv, tgt = R.gae_advantages(rewards, discounts, values, 0.95)
+        np.testing.assert_allclose(np.asarray(adv_p), np.asarray(adv), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(tgt_p), np.asarray(tgt), rtol=1e-5, atol=1e-5)
