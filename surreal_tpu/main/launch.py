@@ -98,6 +98,11 @@ def select_trainer(config):
 
 def run_train(args) -> int:
     config = build_config(args)
+    # must precede first jax use: joins this process into the global
+    # device runtime when a multi-host topology is configured
+    from surreal_tpu.parallel.multihost import initialize_from_topology
+
+    initialize_from_topology(config.session_config.topology)
     os.makedirs(config.session_config.folder, exist_ok=True)
     # persist the resolved config so `eval` (and future resumes) can rebuild
     # the exact learner/env without re-supplying CLI flags

@@ -77,6 +77,11 @@ BASE_SESSION_CONFIG = Config(
         mesh=Config(dp=-1, tp=1),  # -1 -> use all remaining devices
         num_env_workers=0,         # host-side env worker processes (0 = in-process)
         envs_per_worker=32,
+        multihost=Config(          # multi-controller scaling (parallel/multihost.py)
+            coordinator=None,      # "host:port" of process 0 ($JAX_COORDINATOR_ADDRESS)
+            num_processes=None,    # total hosts/processes ($JAX_NUM_PROCESSES); None/1 = single
+            process_id=None,       # this process's rank ($JAX_PROCESS_ID)
+        ),
     ),
     total_env_steps=1_000_000,
     checkpoint=Config(

@@ -1,0 +1,119 @@
+"""Multi-host (multi-controller) scaling: two coordinated OS processes, 4
+simulated devices each, form ONE 8-device global mesh; a dp PPO learn step
+on DIFFERENT per-process data must produce identical post-update params on
+every process — the gradient allreduce crossed the process boundary over
+the DCN plane (SURVEY.md §5.8; the reference scaled hosts with ZMQ process
+groups, the rebuild with jax.distributed + the same shard_map code).
+
+Runs real subprocesses (each needs its OWN jax runtime — in-process
+fixtures can't model process boundaries), so it's marked slow.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+proc_id = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from surreal_tpu.session.config import Config
+from surreal_tpu.parallel.multihost import (
+    initialize_from_topology, local_batch_to_global,
+)
+
+topology = Config(
+    multihost=Config(
+        coordinator=f"127.0.0.1:{port}", num_processes=nprocs, process_id=proc_id
+    )
+)
+assert initialize_from_topology(topology)
+assert jax.process_count() == nprocs
+assert jax.device_count() == 4 * nprocs
+
+import numpy as np
+import jax.numpy as jnp
+from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+from surreal_tpu.learners import build_learner
+from surreal_tpu.parallel.dp import dp_learn
+from surreal_tpu.parallel.mesh import make_mesh, replicate_state
+
+specs = EnvSpecs(
+    obs=ArraySpec((6,), np.dtype(np.float32)),
+    action=ArraySpec((2,), np.dtype(np.float32)),
+)
+learner = build_learner(Config(algo=Config(name="ppo", horizon=8)), specs)
+state = learner.init(jax.random.key(0))  # same seed -> identical everywhere
+mesh = make_mesh(Config(mesh=Config(dp=-1, tp=1)))
+state = replicate_state(mesh, state)
+
+T, B_local = 8, 8  # global batch 16, each process contributes its half
+rng = np.random.default_rng(proc_id)  # DIFFERENT data per process
+mk = lambda shape: rng.normal(size=shape).astype(np.float32)
+local = {
+    "obs": mk((T, B_local, 6)), "next_obs": mk((T, B_local, 6)),
+    "action": np.clip(mk((T, B_local, 2)), -1, 1), "reward": mk((T, B_local)),
+    "done": np.zeros((T, B_local), bool),
+    "terminated": np.zeros((T, B_local), bool),
+    "behavior_logp": np.full((T, B_local), -2.0, np.float32),
+    "behavior": {
+        "mean": np.zeros((T, B_local, 2), np.float32),
+        "log_std": np.zeros((T, B_local, 2), np.float32),
+    },
+}
+batch = local_batch_to_global(mesh, local)
+new_state, metrics = dp_learn(learner, mesh)(state, batch, jax.random.key(1))
+leaves = jax.tree.leaves(new_state.params)
+digest = sum(float(np.abs(np.asarray(l.addressable_data(0))).sum()) for l in leaves)
+print(f"RESULT {proc_id} {float(metrics['loss/pg']):.8f} {digest:.8f}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_dp_learn_stays_in_sync(tmp_path):
+    script = tmp_path / "mh_worker.py"
+    script.write_text(_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + repo
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        # on a deadlocked initialize the first communicate raises and the
+        # children would otherwise outlive the test holding the port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    results = {}
+    for out, p in zip(outs, procs):
+        assert p.returncode == 0, out[-2000:]
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")][-1]
+        _, pid, loss, digest = line.split()
+        results[pid] = (loss, digest)
+    # both processes saw the same loss and hold identical updated params,
+    # though each fed different local data: the psum crossed processes
+    assert results["0"] == results["1"], results
