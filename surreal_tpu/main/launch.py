@@ -61,6 +61,19 @@ def build_config(args) -> Config:
     return overrides.extend(base_config())
 
 
+def _apply_backend(backend: str) -> None:
+    """``session_config.backend``: 'tpu' (default — whatever accelerator
+    jax resolves) or 'cpu' (force host CPU; the reliable override on
+    images whose site hooks pin an accelerator platform at boot). Must run
+    before first jax use."""
+    if backend == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    elif backend != "tpu":
+        raise ValueError(f"session_config.backend {backend!r} not in tpu|cpu")
+
+
 def select_trainer(config):
     """Map config -> driver (the component-dispatch role of the reference's
     launcher, collapsed to one decision):
@@ -98,11 +111,23 @@ def select_trainer(config):
 
 def run_train(args) -> int:
     config = build_config(args)
+    _apply_backend(config.session_config.backend)
     # must precede first jax use: joins this process into the global
     # device runtime when a multi-host topology is configured
     from surreal_tpu.parallel.multihost import initialize_from_topology
 
-    initialize_from_topology(config.session_config.topology)
+    if initialize_from_topology(config.session_config.topology):
+        # the multi-controller PRIMITIVES (global mesh, dp_learn with
+        # cross-process psum, local_batch_to_global) are implemented and
+        # tested (tests/test_multihost.py); the stock CLI trainer loops
+        # are single-controller — failing here beats crashing deep inside
+        # a trainer that feeds process-local batches to a global mesh
+        raise NotImplementedError(
+            "multi-host initialize succeeded, but the stock CLI trainer "
+            "loops are single-controller; build the multi-host loop on "
+            "parallel/multihost.py (dp_learn + local_batch_to_global, see "
+            "tests/test_multihost.py), or run one experiment per process"
+        )
     os.makedirs(config.session_config.folder, exist_ok=True)
     # persist the resolved config so `eval` (and future resumes) can rebuild
     # the exact learner/env without re-supplying CLI flags
@@ -130,6 +155,8 @@ def run_eval(args) -> int:
         return 2
     with open(cfg_path) as f:
         config = Config(json.load(f))
+    # eval must run on the backend the session trained on
+    _apply_backend(config.session_config.backend)
     probe = make_env(config.env_config)
     learner = build_learner(config.learner_config, probe.specs)
     if hasattr(probe, "close"):

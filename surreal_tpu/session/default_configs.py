@@ -75,8 +75,10 @@ BASE_SESSION_CONFIG = Config(
         # mesh axes for the SPMD program; product must divide device count.
         # dp = data parallel (gradient psum), tp = tensor parallel seam.
         mesh=Config(dp=-1, tp=1),  # -1 -> use all remaining devices
-        num_env_workers=0,         # host-side env worker processes (0 = in-process)
-        envs_per_worker=32,
+        # host-side env worker processes (0 = in-process); each worker
+        # steps its own env_config.num_envs-wide batch, so total host envs
+        # = num_env_workers * num_envs
+        num_env_workers=0,
         multihost=Config(          # multi-controller scaling (parallel/multihost.py)
             coordinator=None,      # "host:port" of process 0 ($JAX_COORDINATOR_ADDRESS)
             num_processes=None,    # total hosts/processes ($JAX_NUM_PROCESSES); None/1 = single
