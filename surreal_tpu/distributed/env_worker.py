@@ -80,8 +80,24 @@ def run_env_worker(
                 ),
                 "terminal_obs": out.info.get("terminal_obs", out.obs),
             }
+            if "episode_returns" in out.info:
+                # completed-episode stats ride with the observations
+                # (SURVEY.md §5.5 — the reference's agents pushed these to
+                # tensorplex; here the server aggregates them)
+                msg["episode_returns"] = np.asarray(out.info["episode_returns"])
+                msg["episode_lengths"] = np.asarray(out.info["episode_lengths"])
+        # flush the final step's outcome (transition + any episode stats
+        # riding on it) fire-and-forget — without this the last env.step
+        # before a max_steps/stop exit would be silently lost
+        if "reward" in msg:
+            try:
+                sock.send(pickle.dumps(msg, protocol=5), zmq.NOBLOCK)
+            except zmq.ZMQError:
+                pass
         return steps
     finally:
         if sock is not None:
-            sock.close(0)
+            # small linger so the final fire-and-forget flush actually
+            # leaves the process (close(0) would discard queued sends)
+            sock.close(100)
         env.close()

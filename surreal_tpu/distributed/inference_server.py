@@ -23,6 +23,7 @@ import pickle
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable
 
 import numpy as np
@@ -66,6 +67,14 @@ class InferenceServer:
         self.min_batch = min_batch
         self.max_wait_ms = max_wait_ms
         self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
+
+        # rolling completed-episode stats shipped by workers (SURVEY.md
+        # §5.5); read via episode_stats(). Window matches the host
+        # trainers' hooks.host_metrics (20 episodes) so 'episode/return'
+        # means the same thing on every trainer.
+        self._ep_returns: "deque[float]" = deque(maxlen=20)
+        self._ep_lengths: "deque[float]" = deque(maxlen=20)
+        self._ep_lock = threading.Lock()
 
         self._ctx = zmq.Context.instance()
         self._sock = self._ctx.socket(zmq.ROUTER)
@@ -140,7 +149,23 @@ class InferenceServer:
             self._record(ident, msg, actions[sl], {k: v[sl] for k, v in info.items()})
             self._sock.send_multipart([ident, pickle.dumps(actions[sl], protocol=5)])
 
+    def episode_stats(self) -> dict[str, float] | None:
+        """Rolling mean return/length over the last completed episodes
+        across all workers, or None before any episode finishes."""
+        with self._ep_lock:
+            if not self._ep_returns:
+                return None
+            n = len(self._ep_returns)
+            return {
+                "episode/return": sum(self._ep_returns) / n,
+                "episode/length": sum(self._ep_lengths) / n,
+            }
+
     def _record(self, ident: bytes, msg: dict, actions, info) -> None:
+        if "episode_returns" in msg:
+            with self._ep_lock:
+                self._ep_returns.extend(float(r) for r in msg["episode_returns"])
+                self._ep_lengths.extend(float(l) for l in msg["episode_lengths"])
         track = self._tracks.setdefault(ident, _WorkerTrack())
         if "reward" not in msg and track.steps:
             # obs-only hello on an identity that already has partial steps:

@@ -266,6 +266,7 @@ class SEEDTrainer:
                         "staleness/dropped_chunks": float(dropped_stale),
                         "workers/respawns": float(respawns),
                     },
+                    **(server.episode_stats() or {}),
                 )
                 _, stop_flag = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics

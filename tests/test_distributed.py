@@ -499,3 +499,28 @@ def test_seed_trainer_rejects_ddpg():
     ).extend(base_config())
     with pytest.raises(ValueError, match="OffPolicyTrainer"):
         SEEDTrainer(cfg)
+
+
+def test_seed_episode_stats_flow_from_workers_to_metrics():
+    """Completed-episode stats ride with the workers' observations and
+    surface as rolling means in the trainer metrics (SURVEY §5.5 — the
+    reference's agents pushed these to tensorplex)."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder="/tmp/test_seed_epstats",
+            total_env_steps=1500,  # enough steps for episodes to finish
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(num_env_workers=2),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg)
+    state, metrics = trainer.run()
+    assert "episode/return" in metrics, sorted(metrics)
+    assert metrics["episode/return"] > 0  # CartPole returns are positive
+    assert metrics["episode/length"] > 1
