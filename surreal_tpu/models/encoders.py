@@ -9,6 +9,7 @@ numerically delicate (log-probs, losses).
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Sequence
 
 import flax.linen as nn
@@ -24,7 +25,10 @@ ACTIVATIONS: dict[str, Callable[[jax.Array], jax.Array]] = {
 }
 
 
-def orthogonal_init(scale: float = jnp.sqrt(2.0)):
+def orthogonal_init(scale: float = math.sqrt(2.0)):
+    # math.sqrt, NOT jnp.sqrt: a default-arg expression is evaluated at import
+    # time, and any jnp computation would latch the JAX backend (on this image
+    # the axon TPU platform) before callers can select a platform.
     return nn.initializers.orthogonal(scale)
 
 

@@ -1,0 +1,93 @@
+"""Import hygiene: importing surreal_tpu must never initialize a JAX backend.
+
+Round-2 regression (VERDICT.md r2, weak #1): a ``jnp.sqrt(2.0)``
+default-argument expression in ``models/encoders.py`` ran at import time,
+latching the axon TPU backend before ``__graft_entry__.dryrun_multichip``
+could select the simulated CPU devices — turning the driver's multi-chip
+gate red. The contract this test enforces: every module in the package is
+importable with ZERO backend side effects (no device queries, no jnp
+computations at module scope or in default-arg expressions).
+
+The check runs in a subprocess so this test file's own jax state (conftest
+selects CPU and touches devices) can't mask or pollute the result, and so
+it sees the same interpreter-boot conditions the driver's dryrun does
+(axon sitecustomize active via PYTHONPATH).
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import surreal_tpu
+
+_PKG_ROOT = pathlib.Path(surreal_tpu.__file__).parent
+_REPO_ROOT = _PKG_ROOT.parent
+
+_PROBE = r"""
+import importlib
+import pathlib
+import pkgutil
+import sys
+
+import surreal_tpu
+
+mods = ["surreal_tpu"]
+pkg_path = pathlib.Path(surreal_tpu.__file__).parent
+for info in pkgutil.walk_packages([str(pkg_path)], prefix="surreal_tpu."):
+    if info.name.endswith("__main__"):
+        continue  # runs the CLI unconditionally, by design of `python -m`
+    mods.append(info.name)
+
+for name in sorted(mods):
+    importlib.import_module(name)
+
+# jax._src.xla_bridge._backends is the cache of initialized backend clients;
+# it stays empty until the first real device/array operation (verified on
+# jax 0.9.0). Private API, so fail loudly if it moves rather than silently
+# passing.
+from jax._src import xla_bridge
+
+assert hasattr(xla_bridge, "_backends"), "jax moved xla_bridge._backends; update this probe"
+assert xla_bridge._backends == {}, (
+    f"importing surreal_tpu initialized JAX backend(s) {list(xla_bridge._backends)}: "
+    "some module does device work at import time (module-level jnp call or "
+    "default-arg expression)"
+)
+print("IMPORT_HYGIENE_OK", len(mods))
+"""
+
+
+def test_package_import_initializes_no_backend():
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=str(_REPO_ROOT),
+    )
+    assert proc.returncode == 0, f"probe failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "IMPORT_HYGIENE_OK" in proc.stdout
+    # sanity: the walk actually visited the package, not just the top module
+    n_modules = int(proc.stdout.split("IMPORT_HYGIENE_OK")[1].split()[0])
+    assert n_modules > 30, f"walk found only {n_modules} modules"
+
+
+def test_graft_entry_import_initializes_no_backend():
+    """__graft_entry__ itself must also be import-clean: the driver imports
+    it before calling dryrun_multichip, which is where platform selection
+    happens."""
+    probe = (
+        "import __graft_entry__\n"
+        "from jax._src import xla_bridge\n"
+        "assert xla_bridge._backends == {}, list(xla_bridge._backends)\n"
+        "print('GRAFT_IMPORT_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", probe],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=str(_REPO_ROOT),
+    )
+    assert proc.returncode == 0, f"probe failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "GRAFT_IMPORT_OK" in proc.stdout
