@@ -160,9 +160,20 @@ class SessionHooks:
         ``metrics`` is the iteration's metric scalars — a dict of device
         scalars, or a zero-arg callable returning one (to defer assembling
         host-side extras) — synced to host floats only when the metrics
-        cadence fires. Returns (synced_metrics_or_None, stop) where stop
-        echoes a truthy ``on_metrics(iteration, m)``.
+        cadence fires. ``state`` may likewise be a zero-arg callable
+        resolved only when a state-consuming hook (eval, checkpoint)
+        actually fires — multi-host drivers pass a lambda that pulls the
+        replicated global state to host-local numpy, a transfer too costly
+        to do every iteration. Returns (synced_metrics_or_None, stop)
+        where stop echoes a truthy ``on_metrics(iteration, m)``.
         """
+        state_box = [state]
+
+        def resolve_state():
+            if callable(state_box[0]):
+                state_box[0] = state_box[0]()
+            return state_box[0]
+
         m = None
         if self._metrics_every.track_increment():
             raw = metrics() if callable(metrics) else (metrics or {})
@@ -174,14 +185,14 @@ class SessionHooks:
             self._last_train = m
         evaled: dict[str, float] = {}
         if self.evaluator is not None and self._eval_every.track_increment():
-            evaled = self.evaluator.evaluate(state, key)
+            evaled = self.evaluator.evaluate(resolve_state(), key)
             self._last_eval = evaled
         if m or evaled:
             self.writer.write(env_steps, {**(m or {}), **evaled})
         if self.ckpt is not None and self._ckpt_every.track_increment():
             self.ckpt.save(
                 iteration,
-                state,
+                resolve_state(),
                 env_steps=env_steps,
                 metrics=self.last_metrics,
             )
@@ -192,11 +203,12 @@ class SessionHooks:
         return m, stop
 
     def final_checkpoint(self, iteration: int, env_steps: int, state) -> None:
-        """Always leave a resumable checkpoint at run end."""
+        """Always leave a resumable checkpoint at run end. ``state`` may be
+        a zero-arg callable (see ``end_iteration``)."""
         if self.ckpt is not None and self.ckpt.latest_step() != iteration:
             self.ckpt.save(
                 iteration,
-                state,
+                state() if callable(state) else state,
                 env_steps=env_steps,
                 metrics={**self._last_train, **self._last_eval},
             )

@@ -1,0 +1,278 @@
+"""Multi-host (multi-controller) training driver — the loop that composes
+the multi-host primitives in ``parallel/multihost.py`` into a runnable
+trainer (parity: the reference scaled training across machines with
+symphony-launched process groups — learner on one box, agent pools on
+others, ZMQ between them, SURVEY.md §3.1/§5.8; the rebuild scales the JAX
+way: every host runs THIS SAME program over ONE global device mesh and XLA
+emits ICI collectives within a slice, DCN collectives across hosts).
+
+Per-process discipline (the multi-controller contract):
+
+- **Same program, same seeds.** Every rank derives the identical PRNG key
+  chain, so replicated jit inputs (learn keys, init keys) agree everywhere
+  by construction. Per-host divergence (env seeding, exploration noise) is
+  always an explicit ``fold_in`` of the rank or of the global env index.
+- **Rank 0 owns the session.** Metrics, logs, checkpoints, and eval run on
+  process 0 only, against a HOST-LOCAL numpy copy of the (replicated)
+  state — so the session services stay single-controller and orbax never
+  needs multi-process coordination. Ranks > 0 run no session services and
+  do not even need the session folder mounted.
+- **Restore-and-broadcast.** On startup rank 0 restores (auto-resume /
+  warm-start, same rules as single-host), then broadcasts state + counters
+  to all ranks via a device collective — kill ALL processes, relaunch with
+  the same config, and the curve continues.
+- **Per-host env feed.** ``env_config.num_envs`` is the GLOBAL batch
+  width; each process contributes ``num_envs / process_count``:
+
+  * device envs (``jax:*``): the env carry is created directly as a
+    global array sharded over ``dp`` (a jitted SPMD init — each process
+    materializes only its addressable shards), and the fused
+    rollout+learn ``dp_train_iter`` runs on the global mesh unchanged;
+  * host envs (gym/dm_control/robosuite-class): each process steps its
+    OWN local env batch (the reference's per-machine agent pool), then
+    ``local_batch_to_global`` assembles the global learn batch, every
+    host's slice riding its own devices.
+
+Stop discipline: a reward-target stop decided by rank 0's ``on_metrics``
+is broadcast on metrics-cadence iterations (the only iterations a stop
+can originate, and a schedule every rank computes locally) so all ranks
+leave the collective schedule together — a rank stopping alone would
+deadlock the others' next psum, and agreeing every iteration would
+de-pipeline the async hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+
+from surreal_tpu.launch.hooks import SessionHooks, host_metrics
+from surreal_tpu.launch.rollout import host_rollout, init_device_carry
+from surreal_tpu.launch.trainer import Trainer
+from surreal_tpu.parallel.mesh import check_dp_divisible, replicate_state
+from surreal_tpu.parallel.multihost import local_batch_to_global
+from surreal_tpu.session.config import Config
+
+_COUNTER_SPLIT = 2**31  # int64 counters ride int32 collectives as (hi, lo)
+
+
+def _to_host_local(tree):
+    """Replicated global arrays -> host-local numpy (every process holds a
+    full copy of a fully-replicated array, so this is a local read)."""
+    return jax.tree.map(np.asarray, tree)
+
+
+class MultiHostTrainer(Trainer):
+    """On-policy multi-controller trainer (PPO / IMPALA families).
+
+    Requires ``jax.distributed`` to be initialized first
+    (``parallel.multihost.initialize_from_topology``) so ``jax.devices()``
+    spans all hosts; ``Trainer.__init__`` then builds the GLOBAL mesh and
+    the dp train step with no multi-host-specific code.
+    """
+
+    def __init__(self, config):
+        self.rank = jax.process_index()
+        self.nprocs = jax.process_count()
+        self._agree_fn = None
+        self._agree_sharding = None
+        if self.nprocs < 2:
+            raise ValueError(
+                "MultiHostTrainer needs an initialized multi-process runtime "
+                "(jax.process_count() >= 2); use Trainer for single-host runs"
+            )
+        global_envs = config.env_config.num_envs
+        check_dp_divisible(
+            global_envs, self.nprocs, "num_envs", "the process count"
+        )
+        self.global_num_envs = global_envs
+        self.local_num_envs = global_envs // self.nprocs
+        if config.env_config.name.startswith("jax:"):
+            # device envs are global: the carry is one dp-sharded array, so
+            # Trainer.__init__ sees the GLOBAL batch width (its dp check
+            # must hold globally); carry creation is overridden in run()
+            super().__init__(config)
+        else:
+            # host-env adapters size their worker batch from num_envs:
+            # each process builds only ITS slice of the global env batch
+            local_cfg = Config(
+                env_config=Config(num_envs=self.local_num_envs)
+            ).extend(config)
+            super().__init__(local_cfg)
+            # ...but step accounting stays global
+            self.num_envs = self.global_num_envs
+            self.config = config
+        if self.device_mode:
+            if self.mesh.size == 1:
+                raise ValueError("multi-host run resolved a size-1 mesh")
+        else:
+            from surreal_tpu.parallel.dp import dp_learn
+            from surreal_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(config.session_config.topology)
+            check_dp_divisible(global_envs, self.mesh.shape["dp"])
+            self._learn = dp_learn(self.learner, self.mesh)
+
+    # -- rank-0 session services + cross-rank agreement ---------------------
+    def _broadcast_from_rank0(self, state, iteration: int, env_steps: int):
+        """Ship rank 0's (restored) state + counters to every rank, so
+        ranks > 0 need neither the session folder nor a shared FS."""
+        from jax.experimental import multihost_utils
+
+        counters = np.array(
+            [
+                iteration // _COUNTER_SPLIT, iteration % _COUNTER_SPLIT,
+                env_steps // _COUNTER_SPLIT, env_steps % _COUNTER_SPLIT,
+            ],
+            np.int32,
+        )
+        state, counters = multihost_utils.broadcast_one_to_all(
+            (_to_host_local(state), counters)
+        )
+        c = [int(x) for x in np.asarray(counters)]
+        return state, c[0] * _COUNTER_SPLIT + c[1], c[2] * _COUNTER_SPLIT + c[3]
+
+    def _agree_stop(self, stop: bool) -> bool:
+        """All ranks adopt rank 0's stop decision (a lone stopper would
+        deadlock everyone else's next collective).
+
+        Hand-rolled rather than ``multihost_utils.broadcast_one_to_all``:
+        that helper constructs a fresh jit per call, which would recompile
+        (and open a new gloo/ICI context) EVERY iteration; this one jits
+        once per run. Each process contributes its flag at its own mesh
+        positions; the replicated sum broadcasts rank 0's decision (ranks
+        > 0 contribute zeros)."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self._agree_fn is None:
+            # one flag element per device (1-D dim mapped to ALL mesh axes),
+            # so the local slice is exactly this process's device count
+            self._agree_sharding = NamedSharding(
+                self.mesh, P(tuple(self.mesh.axis_names))
+            )
+            self._agree_fn = jax.jit(
+                lambda x: jnp.minimum(jnp.sum(x), 1),
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+        n_local = len([d for d in self.mesh.devices.flat if d.process_index == self.rank])
+        local = np.full(
+            (n_local,), np.int32(1 if (stop and self.rank == 0) else 0)
+        )
+        flags = jax.make_array_from_process_local_data(self._agree_sharding, local)
+        return bool(self._agree_fn(flags))
+
+    # -- main loop -----------------------------------------------------------
+    def run(
+        self,
+        max_env_steps: int | None = None,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ):
+        """Multi-controller variant of ``Trainer.run``: same cadences and
+        hook behavior, but session services fire on rank 0 only and all
+        ranks stay on one collective schedule. ``on_metrics`` fires on
+        rank 0; its stop decision is broadcast."""
+        cfg = self.config.session_config
+        total = max_env_steps or cfg.total_env_steps
+        steps_per_iter = self.horizon * self.global_num_envs
+        # A stop can only originate on metrics-cadence iterations (rank 0's
+        # hooks gate on_metrics behind the metrics fire), and EVERY rank can
+        # compute that cadence locally — so the cross-host stop agreement
+        # runs only on those iterations and the hot loop stays async the
+        # rest of the time. Mirrors PeriodicTracker: count == iteration,
+        # fires when iteration % period == 0.
+        metrics_every = max(1, cfg.metrics.every_n_iters)
+
+        def maybe_agree_stop(iteration: int, stop: bool) -> bool:
+            if iteration % metrics_every != 0:
+                return False
+            return self._agree_stop(stop)
+
+        key = jax.random.key(self.seed)  # identical chain on every rank
+        key, init_key, env_key = jax.random.split(key, 3)
+        state = self.learner.init(init_key)
+        hooks = SessionHooks(self.config, self.learner) if self.rank == 0 else None
+        try:
+            iteration, env_steps = 0, 0
+            if hooks is not None:
+                state, iteration, env_steps = hooks.restore(state)
+            state, iteration, env_steps = self._broadcast_from_rank0(
+                state, iteration, env_steps
+            )
+            state = replicate_state(self.mesh, state)
+            if hooks is not None:
+                hooks.begin_run(iteration, env_steps)
+
+            def lazy_host_state():
+                return _to_host_local(state)
+
+            if self.device_mode:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                # SPMD carry init: one jitted program over the global mesh;
+                # every leaf is [B_global, ...] sharded over dp, and each
+                # process computes only its addressable shards. Per-env
+                # seeding comes from the global env index (the split inside
+                # init_device_carry), so no rank folding is needed.
+                carry = jax.jit(
+                    lambda k: init_device_carry(
+                        self.env, k, self.global_num_envs
+                    ),
+                    out_shardings=NamedSharding(self.mesh, P("dp")),
+                )(env_key)
+                while env_steps < total:
+                    key, it_key, hk_key = jax.random.split(key, 3)
+                    state, carry, metrics = self._train_iter(state, carry, it_key)
+                    iteration += 1
+                    env_steps += steps_per_iter
+                    stop = False
+                    if hooks is not None:
+                        _, stop = hooks.end_iteration(
+                            iteration, env_steps, lazy_host_state, hk_key,
+                            metrics, on_metrics,
+                        )
+                    if maybe_agree_stop(iteration, stop):
+                        break
+            else:
+                obs = self.env.reset(
+                    seed=self.config.env_config.seed + self.rank
+                )
+                recent_returns: list[float] = []
+                while env_steps < total:
+                    key, r_key, l_key, hk_key = jax.random.split(key, 4)
+                    # act against a host-local param copy (the SEED host
+                    # loop is per-process; only learn is global), with
+                    # per-rank exploration streams
+                    obs, batch, ep_stats = host_rollout(
+                        self.env, self._act, lazy_host_state(), obs,
+                        jax.random.fold_in(r_key, self.rank), self.horizon,
+                    )
+                    gbatch = local_batch_to_global(self.mesh, batch, batch_dim=1)
+                    state, metrics = self._learn(state, gbatch, l_key)
+                    iteration += 1
+                    env_steps += steps_per_iter
+                    recent_returns.extend(ep_stats["returns"])
+                    stop = False
+                    if hooks is not None:
+                        # episode stats are rank-0-local (each host sees
+                        # only its own episodes); learner metrics are
+                        # global — the psum already crossed hosts
+                        _, stop = hooks.end_iteration(
+                            iteration, env_steps, lazy_host_state, hk_key,
+                            host_metrics(metrics, recent_returns), on_metrics,
+                        )
+                    if maybe_agree_stop(iteration, stop):
+                        break
+            if hooks is not None:
+                hooks.final_checkpoint(iteration, env_steps, lazy_host_state)
+            from jax.experimental import multihost_utils
+
+            # leave together: rank 0 may still be writing the final
+            # checkpoint while others would otherwise tear down the runtime
+            multihost_utils.sync_global_devices("surreal_tpu:run_end")
+            return state, (hooks.last_metrics if hooks is not None else {})
+        finally:
+            if hooks is not None:
+                hooks.close()

@@ -116,26 +116,40 @@ def run_train(args) -> int:
     # device runtime when a multi-host topology is configured
     from surreal_tpu.parallel.multihost import initialize_from_topology
 
-    if initialize_from_topology(config.session_config.topology):
-        # the multi-controller PRIMITIVES (global mesh, dp_learn with
-        # cross-process psum, local_batch_to_global) are implemented and
-        # tested (tests/test_multihost.py); the stock CLI trainer loops
-        # are single-controller — failing here beats crashing deep inside
-        # a trainer that feeds process-local batches to a global mesh
-        raise NotImplementedError(
-            "multi-host initialize succeeded, but the stock CLI trainer "
-            "loops are single-controller; build the multi-host loop on "
-            "parallel/multihost.py (dp_learn + local_batch_to_global, see "
-            "tests/test_multihost.py), or run one experiment per process"
-        )
-    os.makedirs(config.session_config.folder, exist_ok=True)
-    # persist the resolved config so `eval` (and future resumes) can rebuild
-    # the exact learner/env without re-supplying CLI flags
-    with open(os.path.join(config.session_config.folder, "config.json"), "w") as f:
-        f.write(config.dumps())
-    trainer = select_trainer(config)
+    multihost = initialize_from_topology(config.session_config.topology)
+    if multihost:
+        algo = config.learner_config.algo.name
+        workers = config.session_config.topology.num_env_workers
+        if algo == "ddpg" or workers > 0:
+            # fail loudly: the off-policy (per-device replay) and SEED
+            # (inference-server) drivers are single-controller today; the
+            # multi-host loop covers the on-policy families
+            raise ValueError(
+                "multi-host training currently supports the on-policy "
+                f"drivers (ppo, impala) without --workers; got algo={algo!r}"
+                f", num_env_workers={workers} — run those single-host, or "
+                "scale them by mesh axes within one host"
+            )
+    import jax
+
+    rank0 = jax.process_index() == 0  # trivially True single-host
+    if rank0:
+        os.makedirs(config.session_config.folder, exist_ok=True)
+        # persist the resolved config so `eval` (and future resumes) can
+        # rebuild the exact learner/env without re-supplying CLI flags
+        with open(
+            os.path.join(config.session_config.folder, "config.json"), "w"
+        ) as f:
+            f.write(config.dumps())
+    if multihost:
+        from surreal_tpu.launch.multihost_trainer import MultiHostTrainer
+
+        trainer = MultiHostTrainer(config)
+    else:
+        trainer = select_trainer(config)
     state, metrics = trainer.run()
-    print(json.dumps({k: v for k, v in sorted(metrics.items())}, default=float))
+    if rank0:
+        print(json.dumps({k: v for k, v in sorted(metrics.items())}, default=float))
     return 0
 
 

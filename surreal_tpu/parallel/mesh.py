@@ -48,13 +48,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def check_dp_divisible(num: int, dp: int, what: str = "num_envs") -> None:
+def check_dp_divisible(
+    num: int, dp: int, what: str = "num_envs", divisor: str = "the dp axis size"
+) -> None:
     """Shared dp-batch guard: every dp trainer shards a batch width over
-    the ``dp`` axis and must reject indivisible configs identically."""
+    the ``dp`` axis and must reject indivisible configs identically.
+    ``divisor`` names what ``dp`` actually is when a caller divides by
+    something else (e.g. the process count), so the error steers the user
+    at the right knob."""
     if num % dp != 0:
-        raise ValueError(
-            f"{what}={num} must be divisible by the dp axis size {dp}"
-        )
+        raise ValueError(f"{what}={num} must be divisible by {divisor} {dp}")
 
 
 def replicate_state(mesh: Mesh, state):

@@ -42,18 +42,35 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self.keep_best = keep_best
         self.best_key = best_key
+        # Checkpointing is single-controller BY DESIGN, even under
+        # jax.distributed: the multi-host driver passes host-local numpy
+        # state and only rank 0 ever constructs a manager
+        # (launch/multihost_trainer.py). Orbax would otherwise detect
+        # process_count > 1 and block every save on a cross-process barrier
+        # that the other ranks never join. active_processes pins all
+        # coordination to the constructing process.
+        mp_options = ocp.options.MultiprocessingOptions(
+            primary_host=jax.process_index(),
+            active_processes={jax.process_index()},
+            barrier_sync_key_prefix=f"surreal_tpu_{jax.process_index()}",
+        )
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=keep_last,
-                create=True,
+                # create=False: orbax refuses create+active_processes; the
+                # makedirs above already guarantees the root exists
+                create=False,
                 # best/ is handled by hand below so keep-last and keep-best
                 # retention compose instead of competing in one policy
+                multiprocessing_options=mp_options,
             ),
         )
         self._best_dir = os.path.join(self.directory, "best")
         self._best_meta_path = os.path.join(self.directory, "best_metric.json")
-        self._best_ckptr = ocp.StandardCheckpointer()
+        self._best_ckptr = ocp.StandardCheckpointer(
+            multiprocessing_options=mp_options
+        )
 
     # -- save ----------------------------------------------------------------
     def save(
@@ -84,8 +101,12 @@ class CheckpointManager:
         # orbax's own tmp-dir + rename makes the overwrite atomic
         self._best_ckptr.save(self._best_dir, payload, force=True)
         self._best_ckptr.wait_until_finished()
-        with open(self._best_meta_path, "w") as f:
+        # tmp + rename: a SIGKILL mid-write (kill-and-resume is a supported
+        # flow) must never leave a truncated meta that crashes the relaunch
+        tmp = self._best_meta_path + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"value": float(value), "step": int(step)}, f)
+        os.replace(tmp, self._best_meta_path)
 
     # -- restore -------------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -95,7 +116,12 @@ class CheckpointManager:
         if not os.path.exists(self._best_meta_path):
             return None
         with open(self._best_meta_path) as f:
-            return json.load(f)
+            try:
+                return json.load(f)
+            except json.JSONDecodeError:
+                # legacy non-atomic write interrupted by a kill: treat as
+                # "no best yet" rather than poisoning every future save
+                return None
 
     def restore(self, template_state: Any, step: int | None = None):
         """Restore (state, meta) at ``step`` (default latest).
