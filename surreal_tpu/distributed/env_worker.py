@@ -88,10 +88,12 @@ def run_env_worker(
                 msg["episode_lengths"] = np.asarray(out.info["episode_lengths"])
         # flush the final step's outcome (transition + any episode stats
         # riding on it) fire-and-forget — without this the last env.step
-        # before a max_steps/stop exit would be silently lost
+        # before a max_steps/stop exit would be silently lost. The 'final'
+        # tag tells the server not to act on it or install pending state
+        # for a worker that is about to be gone.
         if "reward" in msg:
             try:
-                sock.send(pickle.dumps(msg, protocol=5), zmq.NOBLOCK)
+                sock.send(pickle.dumps(dict(msg, final=True), protocol=5), zmq.NOBLOCK)
             except zmq.ZMQError:
                 pass
         return steps

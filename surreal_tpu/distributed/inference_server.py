@@ -67,6 +67,13 @@ class InferenceServer:
         self.min_batch = min_batch
         self.max_wait_ms = max_wait_ms
         self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
+        # data-plane observability (SURVEY.md §5.5: the reference's
+        # tensorplex tracked replay/fetch-queue occupancy): queue-full
+        # evictions cost real env steps — count chunks AND steps so the
+        # trainer can keep its env-step budget honest. Plain ints bumped
+        # only by the server thread; GIL-atomic reads from the trainer.
+        self.evicted_chunks = 0
+        self.evicted_steps = 0
 
         # rolling completed-episode stats shipped by workers (SURVEY.md
         # §5.5); read via episode_stats(). Window matches the host
@@ -135,6 +142,15 @@ class InferenceServer:
         self._sock.close(0)
 
     def _serve_batch(self, requests: list[tuple[bytes, dict]]) -> None:
+        # 'final' flushes come from exiting workers: stitch the transition
+        # they carry, but don't spend a forward choosing actions nobody
+        # will read or install pending state for a dead peer
+        finals = [r for r in requests if r[1].get("final")]
+        for ident, msg in finals:
+            self._record(ident, msg, None, None, final=True)
+        requests = [r for r in requests if not r[1].get("final")]
+        if not requests:
+            return
         obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
         with self._act_lock:
             actions, info = self._act_fn(obs)
@@ -161,7 +177,7 @@ class InferenceServer:
                 "episode/length": sum(self._ep_lengths) / n,
             }
 
-    def _record(self, ident: bytes, msg: dict, actions, info) -> None:
+    def _record(self, ident: bytes, msg: dict, actions, info, final: bool = False) -> None:
         if "episode_returns" in msg:
             with self._ep_lock:
                 self._ep_returns.extend(float(r) for r in msg["episode_returns"])
@@ -201,7 +217,12 @@ class InferenceServer:
                     "param_version": prev["info"]["param_version"],
                 }
             )
-        track.pending = {"obs": np.asarray(msg["obs"]), "action": actions, "info": info}
+        if final:
+            track.pending = None  # worker is exiting; nothing more will come
+        else:
+            track.pending = {
+                "obs": np.asarray(msg["obs"]), "action": actions, "info": info
+            }
         if len(track.steps) >= self.unroll_length:
             chunk = {
                 k: (
@@ -222,9 +243,22 @@ class InferenceServer:
                     # chunk instead would starve a lagging learner on
                     # ever-staler experience)
                     try:
-                        self.chunks.get_nowait()
+                        old = self.chunks.get_nowait()
+                        self.evicted_chunks += 1
+                        self.evicted_steps += int(
+                            old["reward"].shape[0] * old["reward"].shape[1]
+                        )
                     except queue.Empty:
                         pass
+
+    def queue_stats(self) -> dict[str, float]:
+        """Chunk-queue occupancy and eviction counts for the metrics
+        stream (the tensorplex fetch-queue-occupancy role)."""
+        return {
+            "server/queue_depth": float(self.chunks.qsize()),
+            "server/evicted_chunks": float(self.evicted_chunks),
+            "server/evicted_steps": float(self.evicted_steps),
+        }
 
     def close(self) -> None:
         self._stop.set()

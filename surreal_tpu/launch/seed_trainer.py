@@ -36,13 +36,21 @@ from surreal_tpu.distributed.inference_server import InferenceServer
 from surreal_tpu.learners import build_learner
 
 
+_FROM_CONFIG = object()  # sentinel: None is a meaningful max_staleness value
+
+
 class SEEDTrainer:
     def __init__(
         self,
         config,
-        worker_mode: str = "thread",
-        max_staleness: int | None = None,
+        worker_mode: str | None = None,
+        max_staleness: int | None | object = _FROM_CONFIG,
     ):
+        # config is the user-facing path (session.topology.worker_mode,
+        # learner.algo.max_staleness — both CLI-reachable via --set); the
+        # constructor args override for tests/embedding
+        if worker_mode is None:
+            worker_mode = config.session_config.topology.get("worker_mode", "thread")
         if worker_mode not in ("thread", "process"):
             raise ValueError(f"worker_mode {worker_mode!r} not in thread|process")
         algo_name = config.learner_config.algo.name
@@ -65,6 +73,10 @@ class SEEDTrainer:
         self.algo = self.learner.config.algo
         self.num_workers = max(1, config.session_config.topology.num_env_workers)
         self.worker_mode = worker_mode
+        if max_staleness is _FROM_CONFIG:
+            # read the EXTENDED algo tree (build_learner layered per-algo +
+            # base defaults onto it), not the raw user overrides
+            max_staleness = self.algo.get("max_staleness", None)
         self.max_staleness = max_staleness
 
         self._jit_act = jax.jit(self.learner.act, static_argnames="mode")
@@ -232,14 +244,33 @@ class SEEDTrainer:
                                 "no experience chunks arriving from workers"
                             ) from None
 
+            discarded_steps = 0
             while env_steps < total:
                 chunk = next_chunk(chunk_timeout)
                 chunk_timeout = 30.0
                 versions = chunk.pop("param_version")
                 staleness = server.version - int(versions.min())
+                # Accounting contract: trainer-side stale DROPS count into
+                # env_steps (deterministic, the trainer chose to discard);
+                # server-side queue EVICTIONS are surfaced as
+                # server/evicted_* metrics but NOT folded into the budget —
+                # they spike during the learner's first compiles, and
+                # folding them would make run length race against XLA
+                # compile time (observed: the respawn fault-injection test's
+                # budget consumed before the supervisor could act).
                 if self.max_staleness is not None and staleness > self.max_staleness:
+                    # acted by a too-old policy: drop, don't train. The
+                    # steps DID happen — count them, and keep supervising
+                    # workers (a streak of stale chunks must not pause
+                    # respawn or stretch wall-clock past the step budget)
                     dropped_stale += 1
-                    continue  # acted by a too-old policy: drop, don't train
+                    n_dropped = chunk["reward"].shape[0] * chunk["reward"].shape[1]
+                    env_steps += n_dropped
+                    discarded_steps += n_dropped
+                    respawns += self._respawn_dead_workers(
+                        workers, env_cfg, server.address, stop
+                    )
+                    continue
                 if self.mesh is not None:
                     # split host->devices directly along the dp-sharded
                     # batch dim; a plain device_put would commit the whole
@@ -264,8 +295,10 @@ class SEEDTrainer:
                     **{
                         "staleness/updates_behind": float(staleness),
                         "staleness/dropped_chunks": float(dropped_stale),
+                        "staleness/steps_discarded": float(discarded_steps),
                         "workers/respawns": float(respawns),
                     },
+                    **server.queue_stats(),
                     **(server.episode_stats() or {}),
                 )
                 _, stop_flag = hooks.end_iteration(

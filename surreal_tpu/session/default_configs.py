@@ -20,6 +20,10 @@ BASE_LEARNER_CONFIG = Config(
         gamma=0.99,
         n_step=1,
         use_obs_filter=True,  # ZFilter running obs normalization
+        # SEED topology only: drop trajectory chunks whose oldest transition
+        # was acted more than this many updates ago (None = train on all;
+        # V-trace absorbs bounded staleness, PPO-over-SEED should bound it)
+        max_staleness=None,
     ),
     model=Config(
         actor_hidden=(64, 64),
@@ -79,6 +83,10 @@ BASE_SESSION_CONFIG = Config(
         # steps its own env_config.num_envs-wide batch, so total host envs
         # = num_env_workers * num_envs
         num_env_workers=0,
+        # 'thread' (fine for gym classic-control) | 'process' (OS workers,
+        # spawn ctx — MuJoCo-heavy stepping holds the GIL, so real
+        # deployments fork like the reference's actor pool did)
+        worker_mode="thread",
         multihost=Config(          # multi-controller scaling (parallel/multihost.py)
             coordinator=None,      # "host:port" of process 0 ($JAX_COORDINATOR_ADDRESS)
             num_processes=None,    # total hosts/processes ($JAX_NUM_PROCESSES); None/1 = single
@@ -102,6 +110,8 @@ BASE_SESSION_CONFIG = Config(
         every_n_iters=100,
         episodes=5,
         mode="deterministic",  # 'deterministic' | 'stochastic'
+        max_steps=None,        # per-episode step cap (None -> env time limit
+                               # on device, 10k on host)
     ),
     profiler=Config(
         enabled=False,     # jax.profiler trace window (SURVEY.md §5.1)
