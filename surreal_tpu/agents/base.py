@@ -2,11 +2,23 @@
 agent modes, periodic parameter fetch; SURVEY.md §2.1).
 
 In the reference an Agent was a separate OS process holding a torch model
-copy, polling the parameter server. Here an Agent is a *view over learner
-state*: it binds (learner, mode) and acts through the learner's pure
-``act`` fn. "Parameter fetch" collapses to passing the current (or an
-intentionally stale snapshot of the) LearnerState — the staleness seam for
-the async SEED-style serving path lives in ``distributed/``, not here.
+copy, polling the parameter server every K steps. Here an Agent is two
+things, matching the two places actors live in the rebuild:
+
+- **In-program view** (the common case): binds (learner, mode) and acts
+  through the learner's pure ``act`` fn on state the caller holds —
+  "parameter fetch" collapses to passing the current LearnerState because
+  learner and actor share device memory (SURVEY.md §5.8).
+- **Remote actor** (the reference's actual shape, for processes OUTSIDE
+  the SPMD program — eval workers on other machines, external actors):
+  :meth:`connect` gives the agent its own
+  :class:`~surreal_tpu.distributed.param_service.ParameterClient`;
+  :meth:`remote_act` then periodically re-fetches the published acting
+  view (every ``fetch_every`` acts) and tracks the params version so
+  callers can enforce a staleness bound.
+
+Subclasses narrow :meth:`acting_view` to what their actor actually needs
+on the wire (e.g. DDPG ships actor params only, not critic/targets).
 """
 
 from __future__ import annotations
@@ -26,6 +38,8 @@ class Agent:
             raise ValueError(f"mode {mode!r} not in {AGENT_MODES}")
         self.learner = learner
         self.mode = mode
+        self._client = None
+        self.state = None  # local state copy; remote path only
 
     def act(self, state, obs: jax.Array, key: jax.Array):
         """Batched action + behavior ``action_info`` from learner state."""
@@ -35,3 +49,65 @@ class Agent:
         return type(self)(
             self.learner, EVAL_DETERMINISTIC if deterministic else EVAL_STOCHASTIC
         )
+
+    # -- remote actor (reference SURVEY.md §3.2: periodic param fetch) -------
+    def acting_view(self, state) -> dict:
+        """The state slice an actor needs — the wire payload the learner
+        publishes and remote agents fetch. PPO/IMPALA states share the
+        (params, obs_stats) shape; obs_stats rides along because the
+        reference broadcast the ZFilter normalizer learner->actors."""
+        return {"params": state.params, "obs_stats": state.obs_stats}
+
+    def connect(self, server_address: str, state, fetch_every: int = 1) -> "Agent":
+        """Attach to a parameter server. ``state`` is this process's local
+        full learner state (from ``learner.init``); fetched views are
+        merged into it. ``fetch_every``: re-fetch cadence in acts (the
+        reference's every-K-steps fetch)."""
+        from surreal_tpu.distributed.param_service import ParameterClient
+
+        if fetch_every < 1:
+            raise ValueError(f"fetch_every must be >= 1, got {fetch_every}")
+        self.state = state
+        self._client = ParameterClient(server_address, self.acting_view(state))
+        self._fetch_every = fetch_every
+        self._acts_since_fetch = fetch_every  # fetch before the first act
+        return self
+
+    @property
+    def param_version(self) -> int:
+        """Version of the last fetched params (0 until the first fetch) —
+        the staleness signal callers bound against the publisher's
+        version."""
+        return 0 if self._client is None else self._client.version
+
+    def fetch_params(self) -> bool:
+        """Fetch now. Returns True if a published view was merged.
+        Best-effort: a server timeout leaves the local copy in place and
+        returns False (the client recovers its socket for the next try)."""
+        if self._client is None:
+            raise RuntimeError("fetch_params before connect()")
+        self._acts_since_fetch = 0
+        try:
+            view = self._client.fetch()
+        except TimeoutError:
+            return False
+        if view is None:
+            return False
+        self.state = self.state._replace(**view)
+        return True
+
+    def remote_act(self, obs: jax.Array, key: jax.Array):
+        """Act from the locally-held state, re-fetching params every
+        ``fetch_every`` acts (best-effort: acting proceeds on the stale
+        copy when nothing is published yet or the server is slow)."""
+        if self._client is None:
+            raise RuntimeError("remote_act before connect()")
+        self._acts_since_fetch += 1
+        if self._acts_since_fetch >= self._fetch_every:
+            self.fetch_params()
+        return self.act(self.state, obs, key)
+
+    def close(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None

@@ -1,17 +1,60 @@
 """DDPG agent (parity: reference ``surreal/agent/ddpg_agent.py`` —
 deterministic actor + exploration noise (OU / Gaussian) in training mode;
-SURVEY.md §2.1). Gaussian noise lives in :meth:`DDPGLearner.act`; the OU
-variant is stateful and carried by the off-policy collector
-(``launch/offpolicy_trainer.py``) via ``ou_noise_step``.
+SURVEY.md §2.1).
+
+This class owns the pieces of DDPG acting that are AGENT state, not
+learner state:
+
+- **OU exploration noise** is a stateful process (the reference kept it
+  on the agent); :meth:`act` carries it across steps in training mode and
+  :meth:`mask_noise_on_reset` zeroes finished episodes' rows. (Stateless
+  Gaussian noise stays in :meth:`DDPGLearner.act`; the fused on-device
+  collector in ``launch/offpolicy_trainer.py`` carries OU state in its
+  jittable rollout carry instead — same ``ou_noise_step``.)
+- **The actor-only wire view**: a remote DDPG actor fetches actor params
+  + obs normalizer, NOT the critic/target/optimizer state the full
+  ``DDPGState`` carries — a quarter of the bytes per fetch.
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from surreal_tpu.agents.base import Agent
 from surreal_tpu.learners.base import TRAINING
-from surreal_tpu.learners.ddpg import DDPGLearner
+from surreal_tpu.learners.ddpg import DDPGLearner, ou_noise_step
 
 
 class DDPGAgent(Agent):
     def __init__(self, learner: DDPGLearner, mode: str = TRAINING):
         super().__init__(learner, mode)
+        self._noise = None
+
+    def acting_view(self, state) -> dict:
+        return {"actor_params": state.actor_params, "obs_stats": state.obs_stats}
+
+    def reset_noise(self, num_envs: int) -> None:
+        self._noise = jnp.zeros((num_envs, self.learner.act_dim), jnp.float32)
+
+    def mask_noise_on_reset(self, done) -> None:
+        """Zero noise rows whose episode just ended (OU state must not
+        leak across resets — advisor r1 finding on the collector path)."""
+        if self._noise is not None:
+            self._noise = self._noise * (1.0 - jnp.asarray(done, jnp.float32)[:, None])
+
+    def act(self, state, obs: jax.Array, key: jax.Array):
+        """Training mode with OU exploration is STATEFUL (not jittable as
+        a whole — the noise carry lives on the agent); all other modes
+        pass straight through to the pure learner act."""
+        expl = self.learner.config.algo.exploration
+        if self.mode == TRAINING and expl.noise == "ou":
+            if self._noise is None or self._noise.shape[0] != obs.shape[0]:
+                self.reset_noise(obs.shape[0])
+            k_act, k_noise = jax.random.split(key)
+            action, info = self.learner.act(state, obs, k_act, self.mode)
+            self._noise = ou_noise_step(
+                self._noise, k_noise, expl.ou_theta, expl.sigma, expl.ou_dt
+            )
+            return jnp.clip(action + self._noise, -1.0, 1.0), info
+        return self.learner.act(state, obs, key, self.mode)

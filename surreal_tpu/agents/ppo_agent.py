@@ -2,12 +2,19 @@
 from the diagonal-Gaussian (or categorical) policy and returns the
 behavior-policy ``action_info`` attached to experience; SURVEY.md §2.1).
 
-All behavior lives in :class:`PPOLearner.act`; this class exists as the
-named capability seam (and carries the stochastic/deterministic mode
-selection for eval workers).
+Policy math lives in :class:`PPOLearner.act`. This class owns the
+ON-POLICY REMOTE-ACTOR contract: a PPO actor outside the SPMD program
+must attach, to every transition it emits, both the behavior-policy stats
+(for the ratio/KL terms) and the VERSION of the params that chose the
+action — the learner's staleness guard (``algo.max_staleness``, SEED
+trainer) keys off that tag. :meth:`remote_act` stamps it; in-program
+actors get the same tag from the inference server instead.
 """
 
 from __future__ import annotations
+
+import jax
+import numpy as np
 
 from surreal_tpu.agents.base import Agent
 from surreal_tpu.learners.base import TRAINING
@@ -17,3 +24,15 @@ from surreal_tpu.learners.ppo import PPOLearner
 class PPOAgent(Agent):
     def __init__(self, learner: PPOLearner, mode: str = TRAINING):
         super().__init__(learner, mode)
+
+    def remote_act(self, obs: jax.Array, key: jax.Array):
+        """Act from the local params copy and stamp ``param_version`` into
+        the behavior info (the reference attached behavior stats to
+        experience; the version tag is what the TPU learner's staleness
+        policy consumes)."""
+        action, info = super().remote_act(obs, key)
+        info = dict(
+            info,
+            param_version=np.full(np.shape(obs)[0], self.param_version, np.int32),
+        )
+        return action, info

@@ -163,6 +163,7 @@ class ParameterClient:
 
     def __init__(self, server_address: str, template: Any):
         self._ctx = zmq.Context.instance()
+        self._address = server_address
         self._req = self._ctx.socket(zmq.REQ)
         self._req.connect(server_address)
         self.template = template
@@ -170,9 +171,14 @@ class ParameterClient:
 
     def fetch(self, timeout_ms: int = 5000) -> Any | None:
         """Returns the latest params pytree, or None if nothing published
-        yet / timeout. Updates ``self.version``."""
+        yet. Raises TimeoutError on a silent server — after RECOVERING the
+        REQ socket (a strict REQ with an outstanding send would fail every
+        later fetch with EFSM), so callers may simply retry."""
         self._req.send(b"fetch")
         if not self._req.poll(timeout_ms):
+            self._req.close(0)
+            self._req = self._ctx.socket(zmq.REQ)
+            self._req.connect(self._address)
             raise TimeoutError("parameter server did not reply")
         ver, blob = self._req.recv_multipart()
         if ver == b"none":

@@ -1,0 +1,192 @@
+"""Agent layer tests: mode-bound views and the remote-actor loop
+(ParameterPublisher -> ParameterServer -> Agent.connect/remote_act — the
+reference agent's periodic param fetch, SURVEY.md §3.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.agents import Agent, DDPGAgent, PPOAgent
+from surreal_tpu.distributed import ParameterPublisher, ParameterServer
+from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+from surreal_tpu.learners import build_learner
+from surreal_tpu.session.config import Config
+
+
+def _specs(obs_dim=4, act_dim=2):
+    return EnvSpecs(
+        obs=ArraySpec(shape=(obs_dim,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(act_dim,), dtype=np.dtype(np.float32)),
+    )
+
+
+def test_ppo_remote_agent_fetches_published_params_and_stamps_version():
+    """A remote PPOAgent must act on the LEARNER's published params (not
+    its local init) after connect, track the published version, and stamp
+    it into the behavior info it attaches to experience."""
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    learner_state = learner.init(jax.random.key(0))
+
+    pub = ParameterPublisher()
+    ps = ParameterServer(pub.address)
+    agent = None
+    try:
+        # actor process side: own init (different key -> different params)
+        agent = PPOAgent(learner).connect(
+            ps.address, learner.init(jax.random.key(42)), fetch_every=2
+        )
+        obs = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+
+        # nothing published yet: acting proceeds on the local stale copy
+        a0, info0 = agent.remote_act(obs, jax.random.key(1))
+        assert agent.param_version == 0
+        assert np.all(info0["param_version"] == 0)
+
+        pub.publish(agent.acting_view(learner_state))
+        import time
+
+        deadline = time.time() + 5
+        while agent.param_version == 0 and time.time() < deadline:
+            agent.fetch_params()
+            time.sleep(0.05)
+        assert agent.param_version == 1
+        # the merged params ARE the learner's
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(agent.state.params)[0]),
+            np.asarray(jax.tree.leaves(learner_state.params)[0]),
+        )
+        _, info1 = agent.remote_act(obs, jax.random.key(2))
+        assert np.all(info1["param_version"] == 1)
+        assert info1["logp"].shape == (8,)  # behavior stats still attached
+    finally:
+        if agent is not None:
+            agent.close()
+        ps.close()
+        pub.close()
+
+
+def test_ddpg_agent_actor_only_wire_view():
+    """A remote DDPG actor ships actor params + obs normalizer only —
+    never critic/target/optimizer state."""
+    learner = build_learner(Config(algo=Config(name="ddpg")), _specs())
+    state = learner.init(jax.random.key(0))
+    view = DDPGAgent(learner).acting_view(state)
+    assert set(view) == {"actor_params", "obs_stats"}
+    # and the view round-trips through _replace
+    merged = state._replace(**view)
+    assert merged.critic_params is state.critic_params
+
+
+def test_ddpg_agent_ou_noise_is_stateful_and_resets_on_done():
+    """OU exploration is a correlated process carried by the agent: the
+    same obs/key must yield different actions on consecutive acts (noise
+    state advanced), eval modes must be noise-free, and a done mask must
+    zero the finished env's noise row."""
+    learner = build_learner(
+        Config(algo=Config(name="ddpg", exploration=Config(noise="ou", sigma=0.3))),
+        _specs(),
+    )
+    state = learner.init(jax.random.key(0))
+    agent = DDPGAgent(learner)  # training mode
+    obs = jnp.zeros((3, 4))
+    key = jax.random.key(7)
+    a1, _ = agent.act(state, obs, key)
+    a2, _ = agent.act(state, obs, key)  # same key: only noise state differs
+    assert not np.allclose(np.asarray(a1), np.asarray(a2))
+
+    noise_before = np.asarray(agent._noise)
+    agent.mask_noise_on_reset(jnp.array([True, False, False]))
+    noise_after = np.asarray(agent._noise)
+    np.testing.assert_allclose(noise_after[0], 0.0)
+    np.testing.assert_allclose(noise_after[1:], noise_before[1:])
+
+    # eval view: pure deterministic actor, repeatable
+    ev = agent.eval_view(deterministic=True)
+    e1, _ = ev.act(state, obs, jax.random.key(1))
+    e2, _ = ev.act(state, obs, jax.random.key(2))
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2))
+
+
+def test_remote_agent_fetch_cadence_every_act():
+    """fetch_every=1 must re-fetch on EVERY act (regression: an off-by-one
+    made the true period fetch_every+1, so actors ran one publish behind
+    half the time)."""
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    state = learner.init(jax.random.key(0))
+    pub = ParameterPublisher()
+    ps = ParameterServer(pub.address)
+    agent = None
+    try:
+        agent = PPOAgent(learner).connect(
+            ps.address, learner.init(jax.random.key(1)), fetch_every=1
+        )
+        obs = np.zeros((2, 4), np.float32)
+        import time
+
+        for expected in (1, 2):
+            pub.publish(agent.acting_view(state))
+            deadline = time.time() + 5
+            while agent.param_version < expected and time.time() < deadline:
+                agent.remote_act(obs, jax.random.key(expected))
+                time.sleep(0.02)
+            assert agent.param_version == expected
+    finally:
+        if agent is not None:
+            agent.close()
+        ps.close()
+        pub.close()
+
+
+def test_param_client_recovers_socket_after_timeout():
+    """A silent server must not wedge the REQ socket: fetch raises
+    TimeoutError but the NEXT fetch works once a server appears (strict
+    REQ would otherwise fail with EFSM forever), and Agent.fetch_params
+    turns the timeout into best-effort False."""
+    from surreal_tpu.distributed import ParameterClient
+
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    state = learner.init(jax.random.key(0))
+    template = {"params": state.params, "obs_stats": state.obs_stats}
+    # nobody bound here: both fetches must time out, neither may EFSM
+    client = ParameterClient("tcp://127.0.0.1:19", template)
+    try:
+        for _ in range(2):
+            with pytest.raises(TimeoutError):
+                client.fetch(timeout_ms=100)
+    finally:
+        client.close()
+
+    pub = ParameterPublisher()
+    ps = ParameterServer(pub.address)
+    agent = None
+    try:
+        agent = PPOAgent(learner).connect(ps.address, state)
+        # monkey-patch a one-shot timeout, then confirm best-effort acting
+        real_fetch = agent._client.fetch
+        agent._client.fetch = lambda *a, **k: (_ for _ in ()).throw(TimeoutError())
+        assert agent.fetch_params() is False  # stale copy kept, no raise
+        agent._client.fetch = real_fetch
+        pub.publish(agent.acting_view(state))
+        import time
+
+        deadline = time.time() + 5
+        ok = False
+        while not ok and time.time() < deadline:
+            ok = agent.fetch_params()
+            time.sleep(0.05)
+        assert ok
+    finally:
+        if agent is not None:
+            agent.close()
+        ps.close()
+        pub.close()
+
+
+def test_agent_remote_guards():
+    learner = build_learner(Config(algo=Config(name="ppo")), _specs())
+    agent = Agent(learner)
+    with pytest.raises(RuntimeError, match="connect"):
+        agent.remote_act(np.zeros((1, 4), np.float32), jax.random.key(0))
+    with pytest.raises(ValueError, match="fetch_every"):
+        agent.connect("tcp://127.0.0.1:1", learner.init(jax.random.key(0)), 0)
