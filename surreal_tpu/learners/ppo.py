@@ -61,6 +61,8 @@ PPO_LEARNER_CONFIG = Config(
         clip_value=True,      # PPO-style value clipping
         norm_adv=True,
         init_log_std=-0.5,
+        gae_impl="xla",       # 'xla' (lax.scan) | 'pallas' (ops/pallas_gae
+                              # fused kernel; interpret mode off-TPU)
     ),
     replay=Config(kind="fifo"),
 )
@@ -193,19 +195,31 @@ class PPOLearner(Learner):
         deltas_disc = boot_disc
         # (ops.returns.gae_advantages expects a [T+1] value stack; the
         # truncation-exact form here needs distinct bootstrap/decay masks)
-        deltas = batch["reward"] + deltas_disc * v_next - values
         decay = gamma * algo.lam * lam_disc_mask
+        if algo.get("gae_impl", "xla") == "pallas":
+            from surreal_tpu.ops.pallas_gae import gae_advantages_pallas_masked
 
-        def gae_step(carry, xs):
-            delta_t, decay_t = xs
-            adv = delta_t + decay_t * carry
-            return adv, adv
+            advantages, value_targets = gae_advantages_pallas_masked(
+                batch["reward"],
+                deltas_disc,
+                decay,
+                values,
+                v_next,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            deltas = batch["reward"] + deltas_disc * v_next - values
 
-        _, advs_rev = jax.lax.scan(
-            gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1])
-        )
-        advantages = advs_rev[::-1]
-        value_targets = advantages + values
+            def gae_step(carry, xs):
+                delta_t, decay_t = xs
+                adv = delta_t + decay_t * carry
+                return adv, adv
+
+            _, advs_rev = jax.lax.scan(
+                gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1])
+            )
+            advantages = advs_rev[::-1]
+            value_targets = advantages + values
 
         if algo.norm_adv:
             if axis_name is None:

@@ -338,3 +338,48 @@ def test_gae_pallas_matches_scan():
         adv, tgt = R.gae_advantages(rewards, discounts, values, 0.95)
         np.testing.assert_allclose(np.asarray(adv_p), np.asarray(adv), rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(np.asarray(tgt_p), np.asarray(tgt), rtol=1e-5, atol=1e-5)
+
+
+def test_gae_pallas_masked_truncation_exact_and_f32_contract():
+    """The two-mask kernel entry (what `gae_impl=pallas` routes PPO
+    through) must reproduce the truncation-exact recurrence — bootstrap
+    discount uses (1-terminated), accumulation decay uses (1-done) — and
+    honor the documented dtype contract: any input dtype in, f32 out."""
+    from surreal_tpu.ops.pallas_gae import gae_advantages_pallas_masked
+
+    rng = np.random.default_rng(13)
+    T, B = 32, 200  # padded width
+    gamma, lam = 0.99, 0.95
+    rewards = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+    done = jnp.asarray(rng.random((T, B)) < 0.15)
+    # some dones are truncations (episode ends, no true termination)
+    terminated = done & jnp.asarray(rng.random((T, B)) < 0.5)
+    v_t = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+    v_n = jnp.asarray(rng.normal(size=(T, B)), jnp.float32)
+    boot = gamma * (1.0 - terminated.astype(jnp.float32))
+    decay = gamma * lam * (1.0 - done.astype(jnp.float32))
+
+    adv_p, tgt_p = gae_advantages_pallas_masked(
+        rewards, boot, decay, v_t, v_n, interpret=True
+    )
+    # slow reverse-loop reference
+    adv_ref = np.zeros((T, B), np.float32)
+    acc = np.zeros(B, np.float32)
+    for t in reversed(range(T)):
+        delta = np.asarray(rewards[t] + boot[t] * v_n[t] - v_t[t])
+        acc = delta + np.asarray(decay[t]) * acc
+        adv_ref[t] = acc
+    np.testing.assert_allclose(np.asarray(adv_p), adv_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(tgt_p), adv_ref + np.asarray(v_t), rtol=1e-5, atol=1e-5
+    )
+    # dtype contract: bf16 inputs are cast in, outputs are f32
+    adv_bf, tgt_bf = gae_advantages_pallas_masked(
+        rewards.astype(jnp.bfloat16),
+        boot.astype(jnp.bfloat16),
+        decay.astype(jnp.bfloat16),
+        v_t.astype(jnp.bfloat16),
+        v_n.astype(jnp.bfloat16),
+        interpret=True,
+    )
+    assert adv_bf.dtype == jnp.float32 and tgt_bf.dtype == jnp.float32
