@@ -1,0 +1,322 @@
+"""Perf report: the utilization story behind bench.py's headline number
+(VERDICT r2 item 4 — "turn one number into a utilization story").
+
+Runs three graded-workload-class benchmarks on the real chip and writes
+PERF.md next to the driver's BENCH artifacts:
+
+1. PPO + MLP on ``jax:lift``  (the headline: BASELINE config ③/north-star
+   class) — steps/s, XLA-reported FLOP/s, MFU, and a rollout-vs-learn
+   top-line breakdown, plus a jax.profiler trace window.
+2. IMPALA + NatureCNN on ``jax:pong``  (BASELINE config ⑤ class).
+3. DDPG + prioritized replay on ``jax:lift``  (BASELINE config ③ class).
+
+MFU uses the TPU v5e public peak (197 TFLOP/s bf16). RL env-step
+workloads are not matmul-bound — tiny MLPs, env physics, scatter-heavy
+replay — so single-digit MFU is expected and honest; the headline metric
+remains env steps/s/chip (BASELINE.json), MFU says what the chip had left.
+
+Usage:  python perf_report.py            # writes PERF.md
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from bench import PEAK_FLOPS_BF16, _iter_flops
+
+WARMUP = 2
+ITERS = 10  # match bench.py's window; short windows over the tunneled
+            # chip showed ~1.6x run-to-run spread on sub-ms iterations
+
+
+def _timeit(fn, *args, iters=ITERS, split_key=True, key=None):
+    """Time ``iters`` calls of a compiled fn; returns (seconds, last_out)."""
+    out = None
+    t0 = time.perf_counter()
+    k = key
+    for _ in range(iters):
+        if split_key and k is not None:
+            k, sub = jax.random.split(k)
+            out = fn(*args, sub)
+        else:
+            out = fn(*args)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0, out
+
+
+def ppo_lift_headline() -> dict:
+    from surreal_tpu.launch.rollout import device_rollout, init_device_carry
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    num_envs, horizon = 4096, 256
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=horizon, epochs=4, num_minibatches=4),
+        ),
+        env_config=Config(name="jax:lift", num_envs=num_envs),
+        session_config=Config(
+            folder="/tmp/perf_lift",
+            metrics=Config(every_n_iters=10_000),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    key = jax.random.key(0)
+    key, init_key, env_key = jax.random.split(key, 3)
+    state = trainer.learner.init(init_key)
+    carry = init_device_carry(trainer.env, env_key, num_envs)
+
+    for _ in range(WARMUP):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.block_until_ready(metrics)
+    flops = _iter_flops(trainer._train_iter, state, carry, key)
+
+    dt, _ = _timeit(
+        lambda s, c, k: trainer._train_iter(s, c, k)[2], state, carry, key=key
+    )
+    # keep state/carry from the timing loop out of the breakdown: re-run
+    # the pieces on the same shapes
+    sps = ITERS * num_envs * horizon / dt
+
+    # top-line breakdown: rollout-only vs learn-only compiled separately
+    # (the fused iter overlaps them in one program; this is the attribution)
+    roll = jax.jit(
+        lambda s, c, k: device_rollout(
+            trainer.env, trainer.learner, s, c, k, horizon
+        )
+    )
+    key, rk = jax.random.split(key)
+    carry2, batch = roll(state, carry, rk)
+    jax.block_until_ready(batch)
+    dt_roll, _ = _timeit(lambda s, c, k: roll(s, c, k)[1], state, carry, key=key)
+
+    learn_batch = {
+        k: batch[k]
+        for k in ("obs", "next_obs", "action", "reward", "done", "terminated",
+                  "behavior_logp", "behavior")
+    }
+    learn = jax.jit(trainer.learner.learn)
+    key, lk = jax.random.split(key)
+    s2, m2 = learn(state, learn_batch, lk)
+    jax.block_until_ready(m2)
+    dt_learn, _ = _timeit(
+        lambda s, b, k: learn(s, b, k)[1], state, learn_batch, key=key
+    )
+
+    # profiler window over two fused iters (SURVEY.md §5.1)
+    trace_dir = "/tmp/perf_lift/profile"
+    try:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(2):
+                key, it_key = jax.random.split(key)
+                state, carry, metrics = trainer._train_iter(state, carry, it_key)
+            jax.block_until_ready(metrics)
+        traced = True
+    except Exception:
+        traced = False
+
+    out = {
+        "workload": "PPO+MLP jax:lift (BASELINE ③/north-star class)",
+        "geometry": f"{num_envs} envs x {horizon} horizon, 4 epochs x 4 minibatches",
+        "env_steps_per_s": sps,
+        "iter_ms": dt / ITERS * 1e3,
+        "rollout_only_ms": dt_roll / ITERS * 1e3,
+        "learn_only_ms": dt_learn / ITERS * 1e3,
+        "trace_dir": trace_dir if traced else None,
+    }
+    if flops is not None:
+        out["flops_per_iter"] = flops
+        out["model_flops_per_s"] = flops * ITERS / dt
+        out["mfu"] = out["model_flops_per_s"] / PEAK_FLOPS_BF16
+    return out
+
+
+def impala_pong() -> dict:
+    from surreal_tpu.launch.rollout import init_device_carry
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    num_envs, horizon = 1024, 32
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="impala", horizon=horizon),
+            model=Config(cnn=Config(enabled=True)),
+        ),
+        env_config=Config(name="jax:pong", num_envs=num_envs),
+        session_config=Config(
+            folder="/tmp/perf_pong",
+            metrics=Config(every_n_iters=10_000),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    key = jax.random.key(0)
+    key, init_key, env_key = jax.random.split(key, 3)
+    state = trainer.learner.init(init_key)
+    carry = init_device_carry(trainer.env, env_key, num_envs)
+    for _ in range(WARMUP):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.block_until_ready(metrics)
+    flops = _iter_flops(trainer._train_iter, state, carry, key)
+    dt, _ = _timeit(
+        lambda s, c, k: trainer._train_iter(s, c, k)[2], state, carry, key=key
+    )
+    sps = ITERS * num_envs * horizon / dt
+    out = {
+        "workload": "IMPALA+NatureCNN jax:pong pixels (BASELINE ⑤ class)",
+        "geometry": f"{num_envs} envs x {horizon} unroll, 42x42x2 uint8 pixels",
+        "env_steps_per_s": sps,
+        "iter_ms": dt / ITERS * 1e3,
+    }
+    if flops is not None:
+        out["flops_per_iter"] = flops
+        out["model_flops_per_s"] = flops * ITERS / dt
+        out["mfu"] = out["model_flops_per_s"] / PEAK_FLOPS_BF16
+    return out
+
+
+def ddpg_prioritized_lift() -> dict:
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    num_envs, horizon = 2048, 16
+    steps_per_iter = num_envs * horizon
+
+    def make_trainer():
+        cfg = Config(
+            learner_config=Config(
+                algo=Config(name="ddpg", horizon=horizon,
+                            exploration=Config(warmup_steps=0)),
+                replay=Config(kind="prioritized", capacity=200_000,
+                              start_sample_size=steps_per_iter,
+                              batch_size=256),
+            ),
+            env_config=Config(name="jax:lift", num_envs=num_envs),
+            session_config=Config(
+                folder="/tmp/perf_ddpg",
+                metrics=Config(every_n_iters=10_000, tensorboard=False,
+                               console=False),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+            ),
+        ).extend(base_config())
+        return OffPolicyTrainer(cfg)
+
+    trainer = make_trainer()
+    # warmup run: compile everything (jit cache lives on the trainer)
+    trainer.run(max_env_steps=2 * steps_per_iter)
+    t0 = time.perf_counter()
+    trainer.run(max_env_steps=ITERS * steps_per_iter)
+    dt = time.perf_counter() - t0
+    sps = ITERS * steps_per_iter / dt
+    return {
+        "workload": "DDPG+prioritized replay jax:lift (BASELINE ③ class)",
+        "geometry": (
+            f"{num_envs} envs x {horizon} collect, 64 updates/iter x 256 batch, "
+            "200k prioritized replay"
+        ),
+        "env_steps_per_s": sps,
+        "iter_ms": dt / ITERS * 1e3,
+    }
+
+
+def main() -> None:
+    rows = []
+    for fn in (ppo_lift_headline, impala_pong, ddpg_prioritized_lift):
+        r = fn()
+        rows.append(r)
+        print(json.dumps(r, default=float))
+
+    dev = jax.devices()[0]
+    lines = [
+        "# PERF — measured utilization report",
+        "",
+        f"Device: `{dev.device_kind}` (1 chip; via the axon tunnel). "
+        f"MFU denominator: {PEAK_FLOPS_BF16 / 1e12:.0f} TFLOP/s (TPU v5e "
+        "public bf16 peak). FLOPs are XLA's own `cost_analysis()` of the "
+        "compiled training iteration — model + env + optimizer, everything "
+        "in the program.",
+        "",
+        "RL env-step workloads are usually not matmul-bound (small MLPs, "
+        "env physics, scatter-heavy replay) — MFU here says what fraction "
+        "of the chip the headline steps/s actually uses; the graded metric "
+        "stays env steps/s/chip.",
+        "",
+        "| Workload | Geometry | env steps/s/chip | iter ms | FLOP/s | MFU |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        fl = r.get("model_flops_per_s")
+        mfu = r.get("mfu")
+        lines.append(
+            "| {w} | {g} | {s:,.0f} | {ms:.1f} | {fl} | {mfu} |".format(
+                w=r["workload"],
+                g=r["geometry"],
+                s=r["env_steps_per_s"],
+                ms=r["iter_ms"],
+                fl=f"{fl / 1e12:.2f} TFLOP/s" if fl else "n/a",
+                mfu=f"{mfu * 100:.2f}%" if mfu else "n/a",
+            )
+        )
+    head = rows[0]
+    parts_sum = head["rollout_only_ms"] + head["learn_only_ms"]
+    if head["iter_ms"] < 0.9 * parts_sum:
+        verdict = (
+            "The fused iteration beats rollout+learn compiled separately "
+            f"({head['iter_ms']:.2f} ms vs {parts_sum:.2f} ms summed): one "
+            "program lets XLA overlap env stepping with learning work and "
+            "keep intermediates in HBM/VMEM instead of round-tripping "
+            "between dispatches — the reason the trainer fuses the whole "
+            "iteration."
+        )
+    else:
+        verdict = (
+            "Rollout and learn compiled separately sum close to the fused "
+            f"iteration ({parts_sum:.2f} ms vs {head['iter_ms']:.2f} ms): "
+            "fusion is not load-bearing at this geometry; the split shows "
+            "which half dominates."
+        )
+    lines += [
+        "",
+        "## Top-line breakdown (headline workload)",
+        "",
+        f"- fused train iteration: {head['iter_ms']:.2f} ms",
+        f"- rollout-only program (policy forward + env step x 256): "
+        f"{head['rollout_only_ms']:.2f} ms",
+        f"- learn-only program (GAE + 4x4 minibatch SGD): "
+        f"{head['learn_only_ms']:.2f} ms",
+        "",
+        verdict,
+    ]
+    if head.get("trace_dir"):
+        lines += [
+            "",
+            f"A `jax.profiler` trace of two fused iterations was captured to "
+            f"`{head['trace_dir']}` (TensorBoard profile plugin format; not "
+            "committed — rerun `python perf_report.py` to regenerate).",
+        ]
+    lines += [
+        "",
+        "_Generated by `perf_report.py`; bench.py prints the headline line "
+        "with `mfu` for the driver's BENCH artifact._",
+        "",
+    ]
+    with open("PERF.md", "w") as f:
+        f.write("\n".join(lines))
+    print("wrote PERF.md")
+
+
+if __name__ == "__main__":
+    main()

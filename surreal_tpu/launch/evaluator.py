@@ -40,18 +40,21 @@ class Evaluator:
         )
         self.agent = Agent(learner, mode)
         self._jax_eval = None
+        # ``eval_config.max_steps`` overrides the per-episode step cap
+        # (default: env time limit on device, 10k on host)
+        cap = eval_config.get("max_steps", None)
         # eval owns its env instance; host eval uses `episodes` parallel envs
         probe = make_env(env_config)
         if is_jax_env(probe):
             self.env = probe
-            self._time_limit = self.env.time_limit or 1000
+            self._time_limit = int(cap) if cap else (self.env.time_limit or 1000)
             self._jax_eval = jax.jit(self._device_eval)
         else:
             probe.close()
             self.env = make_env(
                 Config(num_envs=self.episodes).extend(env_config)
             )
-            self._time_limit = 10_000  # hard cap on host eval stepping
+            self._time_limit = int(cap) if cap else 10_000
             self._host_act = jax.jit(self.agent.act)  # one cache for all evals
 
     # -- device path ---------------------------------------------------------
@@ -104,19 +107,26 @@ class Evaluator:
         ret = np.zeros(B, np.float32)
         length = np.zeros(B, np.int32)
         alive = np.ones(B, bool)
+        success = np.zeros(B, bool)
         for _ in range(self._time_limit):
             key, akey = jax.random.split(key)
             action, _ = self._host_act(state, jnp.asarray(obs), akey)
             out = env.step(np.asarray(action))
             ret += out.reward * alive
             length += alive.astype(np.int32)
+            info_success = out.info.get("success")
+            if info_success is not None:
+                success |= np.asarray(info_success, bool) & alive
             alive &= ~out.done
             obs = out.obs
             if not alive.any():
                 break
+        # same metric namespace as the device path (eval/success stays 0.0
+        # for envs that never report success — robosuite-class tasks do)
         return {
             "eval/return": float(ret.mean()),
             "eval/length": float(length.mean()),
+            "eval/success": float(success.astype(np.float32).mean()),
         }
 
     def evaluate(self, state, key: jax.Array) -> dict[str, float]:

@@ -251,7 +251,9 @@ def host_metrics(metrics, recent_returns, window: int = 20):
     def build():
         m = dict(metrics)
         if recent_returns:
-            m["episode/return"] = float(np.mean(recent_returns[-window:]))
+            # list(...) first: callers pass a deque(maxlen=window), which
+            # doesn't support slice indexing
+            m["episode/return"] = float(np.mean(list(recent_returns)[-window:]))
         return m
 
     return build

@@ -239,14 +239,20 @@ class MultiHostTrainer(Trainer):
                 obs = self.env.reset(
                     seed=self.config.env_config.seed + self.rank
                 )
-                recent_returns: list[float] = []
+                from collections import deque
+
+                recent_returns: deque = deque(maxlen=20)  # host_metrics window
                 while env_steps < total:
                     key, r_key, l_key, hk_key = jax.random.split(key, 4)
                     # act against a host-local param copy (the SEED host
                     # loop is per-process; only learn is global), with
-                    # per-rank exploration streams
+                    # per-rank exploration streams. device_put ONCE per
+                    # iteration: passing the numpy pytree straight into the
+                    # per-step jitted act would re-upload the full param
+                    # tree on every env step of the rollout
+                    act_state = jax.device_put(lazy_host_state())
                     obs, batch, ep_stats = host_rollout(
-                        self.env, self._act, lazy_host_state(), obs,
+                        self.env, self._act, act_state, obs,
                         jax.random.fold_in(r_key, self.rank), self.horizon,
                     )
                     gbatch = local_batch_to_global(self.mesh, batch, batch_dim=1)

@@ -145,7 +145,9 @@ class Trainer:
                         break
             else:
                 obs = self.env.reset(seed=self.config.env_config.seed)
-                recent_returns = []
+                from collections import deque
+
+                recent_returns = deque(maxlen=20)  # host_metrics window
                 while env_steps < total:
                     key, r_key, l_key, hk_key = jax.random.split(key, 4)
                     obs, batch, ep_stats = host_rollout(

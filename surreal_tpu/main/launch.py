@@ -169,8 +169,9 @@ def run_eval(args) -> int:
         return 2
     with open(cfg_path) as f:
         config = Config(json.load(f))
-    # eval must run on the backend the session trained on
-    _apply_backend(config.session_config.backend)
+    # eval must run on the backend the session trained on; sessions saved
+    # before the backend knob existed default to tpu (the old behavior)
+    _apply_backend(config.session_config.get("backend", "tpu"))
     probe = make_env(config.env_config)
     learner = build_learner(config.learner_config, probe.specs)
     if hasattr(probe, "close"):
@@ -189,7 +190,9 @@ def run_eval(args) -> int:
     state, meta = restored
     mgr.close()
 
-    eval_cfg = Config(episodes=args.episodes, mode=args.mode)
+    eval_cfg = Config(
+        episodes=args.episodes, mode=args.mode, max_steps=args.max_steps
+    )
     ev = Evaluator(config.env_config, eval_cfg, learner)
     out = ev.evaluate(state, jax.random.key(args.seed))
     ev.close()
@@ -226,6 +229,9 @@ def main(argv=None) -> int:
                    default="deterministic")
     e.add_argument("--best", action="store_true",
                    help="use the keep-best checkpoint instead of the latest")
+    e.add_argument("--max-steps", type=int, default=None,
+                   help="per-episode step cap (default: env time limit on "
+                        "device envs, 10000 on host envs)")
     e.add_argument("--seed", type=int, default=0)
     e.set_defaults(fn=run_eval)
 

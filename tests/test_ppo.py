@@ -56,6 +56,35 @@ def test_ppo_learn_updates_params_and_metrics_finite():
     assert float(new_state.obs_stats.count) > float(state.obs_stats.count)
 
 
+def test_ppo_gae_impl_pallas_matches_xla_end_to_end():
+    """`learner_config.algo.gae_impl='pallas'` routes GAE through the
+    fused Pallas kernel (interpret mode off-TPU) and must produce the same
+    update as the default lax.scan path — the kernel is a config seam, not
+    a manual swap (VERDICT r2 item 8)."""
+    batch = _fake_batch(jax.random.key(1))
+    results = {}
+    for impl in ("xla", "pallas"):
+        learner = build_learner(
+            Config(algo=Config(name="ppo", gae_impl=impl)), _continuous_specs()
+        )
+        state = learner.init(jax.random.key(0))
+        new_state, metrics = jax.jit(learner.learn)(state, batch, jax.random.key(2))
+        results[impl] = (new_state, metrics)
+    for k in results["xla"][1]:
+        np.testing.assert_allclose(
+            float(results["xla"][1][k]),
+            float(results["pallas"][1][k]),
+            rtol=1e-5,
+            atol=1e-6,
+            err_msg=f"metric {k} diverges between gae_impl=xla and pallas",
+        )
+    px, pp = results["xla"][0].params, results["pallas"][0].params
+    chex_equal = jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6), px, pp
+    )
+    del chex_equal
+
+
 def test_ppo_adaptive_kl_mode_runs_and_adapts_beta():
     learner = build_learner(
         Config(algo=Config(name="ppo", ppo_mode="adapt", kl_target=1e-6)),
@@ -182,6 +211,41 @@ def test_trainer_host_mode_gym_end_to_end():
     assert np.isfinite(metrics["loss/pg"])
     assert np.isfinite(metrics["loss/value"])
     assert metrics["time/env_steps"] >= 16 * 4 * 4
+
+
+@pytest.mark.slow
+def test_ppo_cheetah_run_improves():
+    """BASELINE config ② end-to-end: PPO on dm_control cheetah-run (host
+    adapter, 16 envs) must IMPROVE — late-run episode return above the
+    early-run mean (absolute thresholds would need hours; improvement in
+    ~150k steps is the does-it-learn signal the reference validated with,
+    SURVEY.md §4)."""
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=128, epochs=4),
+        ),
+        env_config=Config(name="dm_control:cheetah-run", num_envs=16),
+        session_config=Config(
+            folder="/tmp/test_ppo_cheetah",
+            seed=3,
+            total_env_steps=150_000,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    returns = []
+    Trainer(cfg).run(
+        on_metrics=lambda it, m: returns.append(m.get("episode/return", np.nan))
+    )
+    returns = np.asarray(returns, np.float64)
+    valid = returns[np.isfinite(returns)]
+    assert len(valid) >= 10, f"too few completed episodes: {returns}"
+    early = valid[: max(3, len(valid) // 4)].mean()
+    late = valid[-max(3, len(valid) // 4):].mean()
+    assert late > early + 5.0 and late > 2 * early, (
+        f"no improvement on cheetah-run: early {early:.1f} -> late {late:.1f}"
+    )
 
 
 @pytest.mark.slow

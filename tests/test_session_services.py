@@ -311,6 +311,68 @@ def test_profiler_trace_window_writes_profile(tmp_path):
     assert any(os.path.isfile(f) for f in trace_files), trace_files
 
 
+def test_host_eval_metric_namespace_and_step_cap():
+    """VERDICT r2 item 9: the host eval path must return the SAME metric
+    namespace as the device path (eval/success included, 0.0 when the env
+    never reports success) and honor a configurable step cap."""
+    from surreal_tpu.envs.base import DiscreteSpec
+    from surreal_tpu.launch.evaluator import Evaluator
+    from surreal_tpu.session.default_configs import BASE_ENV_CONFIG
+
+    env_cfg = Config(name="gym:CartPole-v1", num_envs=1).extend(BASE_ENV_CONFIG)
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(4,), dtype=np.dtype(np.float32)),
+        action=DiscreteSpec(shape=(), dtype=np.dtype(np.int32), n=2),
+    )
+    learner = build_learner(Config(algo=Config(name="ppo")), specs)
+    state = learner.init(jax.random.key(0))
+    ev = Evaluator(env_cfg, Config(episodes=2, mode="deterministic", max_steps=5), learner)
+    try:
+        out = ev.evaluate(state, jax.random.key(1))
+        assert set(out) == {"eval/return", "eval/length", "eval/success"}
+        assert out["eval/success"] == 0.0  # CartPole reports no success
+        assert out["eval/length"] <= 5  # cap respected
+    finally:
+        ev.close()
+
+
+def test_cli_eval_best_with_video_and_step_cap(tmp_path):
+    """`eval --best --max-steps` through the CLI on a host env with video
+    enabled: restores the keep-best checkpoint, records an episode video,
+    and returns the full eval namespace (VERDICT r2 item 9)."""
+    from surreal_tpu.main.launch import main
+
+    folder = str(tmp_path / "exp")
+    vdir = str(tmp_path / "videos")
+    rc = main([
+        "train", "ppo", "gym:CartPole-v1",
+        "--folder", folder, "--num-envs", "4", "--total-steps", str(16 * 4 * 3),
+        "--set",
+        "learner_config.algo.horizon=16",
+        "learner_config.algo.epochs=1",
+        "session_config.backend=cpu",
+        "session_config.metrics.every_n_iters=1",
+        "session_config.metrics.tensorboard=false",
+        "session_config.metrics.console=false",
+        # eval cadence feeds the keep-best tracker during training
+        "session_config.eval.every_n_iters=1",
+        "session_config.eval.episodes=1",
+        "session_config.eval.max_steps=50",
+        "session_config.checkpoint.every_n_iters=1",
+        f'session_config.eval.video_dir="{vdir}"',  # ignored key is fine
+        f'env_config.video.enabled=true',
+        f'env_config.video.dir="{vdir}"',
+        "env_config.video.every_n_episodes=1",
+    ])
+    assert rc == 0
+    assert os.path.exists(os.path.join(folder, "checkpoints", "best_metric.json"))
+    rc = main(["eval", "--folder", folder, "--best", "--episodes", "1",
+               "--max-steps", "30"])
+    assert rc == 0
+    files = os.listdir(vdir)
+    assert any(f.startswith("episode_") for f in files), files
+
+
 def test_cli_rejects_workers_for_incompatible_topology():
     """--workers (num_env_workers>0) with a jax env or ddpg must fail
     loudly instead of silently running a different topology."""
