@@ -40,20 +40,21 @@ def _builtin_jax_envs():
     from surreal_tpu.envs.jax.cartpole import CartPole
     from surreal_tpu.envs.jax.pendulum import Pendulum
 
+    # all first-party pure-JAX modules (jax/numpy only — no optional
+    # deps): import unconditionally so a broken module surfaces instead
+    # of silently unregistering its envs
+    from surreal_tpu.envs.jax.lift import BlockLift
+    from surreal_tpu.envs.jax.nut_assembly import NutAssembly
+    from surreal_tpu.envs.jax.pixels import BlockLiftPixels, NutAssemblyPixels
+    from surreal_tpu.envs.jax.pong import Pong
+
     _JAX_ENVS.setdefault("cartpole", CartPole)
     _JAX_ENVS.setdefault("pendulum", Pendulum)
-    try:
-        from surreal_tpu.envs.jax.lift import BlockLift
-
-        _JAX_ENVS.setdefault("lift", BlockLift)
-    except ImportError:
-        pass
-    try:
-        from surreal_tpu.envs.jax.pong import Pong
-
-        _JAX_ENVS.setdefault("pong", Pong)
-    except ImportError:
-        pass
+    _JAX_ENVS.setdefault("lift", BlockLift)
+    _JAX_ENVS.setdefault("pong", Pong)
+    _JAX_ENVS.setdefault("nut", NutAssembly)
+    _JAX_ENVS.setdefault("lift_pixels", BlockLiftPixels)
+    _JAX_ENVS.setdefault("nut_pixels", NutAssemblyPixels)
 
 
 def is_jax_env(env: AnyEnv) -> bool:

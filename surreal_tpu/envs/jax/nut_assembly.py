@@ -1,0 +1,223 @@
+"""On-device NutAssembly-class manipulation task (BASELINE config ④'s
+workload: "PPO Robosuite NutAssembly pixels" — the pixel variant lives in
+``envs/jax/pixels.py``; this module is the task itself, state obs).
+
+Parity note (same provenance as ``lift.py``): robosuite and MJX are absent
+from this image, so the reference's NutAssembly (grasp a nut, thread it
+onto its peg) is re-implemented as a pure-JAX functional env sharing
+``lift.py``'s rigid-grasp-limit physics (SURVEY.md §2.2 robosuite row, §7).
+The task extends lifting with the insertion objective that makes
+NutAssembly the harder benchmark: a staged reach -> grasp -> carry ->
+place problem.
+
+Model:
+- **Gripper**: identical to ``lift.py`` — position-actuated parallel-jaw
+  hand on a 3-DoF gantry + 1-DoF opening; 4-dim canonical [-1, 1] action.
+- **Nut**: a square nut, block-sized for the grasp model, spawning on the
+  left half of the table.
+- **Peg**: a fixed vertical post on the right. When the nut is released
+  (or slips) with its center inside the peg's capture radius and below
+  the peg top, it THREADS: it slides down the post (xy clamped to the peg
+  axis) and rests at the base — robosuite's success condition.
+
+Reward (dense, staged, max ~6/step over the 200-step episode — the same
+scale as ``lift.py`` so wall-clock targets compare): reach term toward
+the nut, continuous squeeze shaping, a carry term toward the hover point
+above the peg, and a dominant threaded bonus. ``info['success']`` marks
+threaded-at-rest steps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+from surreal_tpu.envs.jax.base import JaxEnv
+from surreal_tpu.envs.jax.lift import (
+    _BLOCK_HALF,
+    _BLOCK_MASS,
+    _G,
+    _GRIP_V_MAX,
+    _GRIP_W_MAX,
+    _GRIP_W_SPEED,
+    _MU,
+    _N_SUB,
+    _SLIP_DRAG,
+    _TABLE_FRICTION,
+    _TABLE_XY,
+    _WS_XY,
+    _WS_Z_MAX,
+    _DT,
+    LiftState,
+    _grasp_force,
+)
+
+# peg geometry (table top is z = 0). Plain numpy: module import must stay
+# device-free (VERDICT r2 item 1 — jnp at import latches the backend)
+PEG_XY = np.array([0.15, 0.15], dtype=np.float32)  # post axis position
+PEG_HEIGHT = 0.10          # post top [m]
+PEG_CAPTURE_R = 0.018      # nut-center capture radius for threading [m]
+_NUT_SPAWN_X = (-0.20, 0.0)  # nut spawns left of the peg
+_NUT_SPAWN_Y = 0.15
+_HOVER = 0.03              # carry target height above the peg top
+
+
+class NutState(NamedTuple):
+    hand: LiftState        # gripper + nut as the "block" of the grasp model
+    threaded: jax.Array    # [] bool — nut is on the peg
+
+
+class NutAssembly(JaxEnv):
+    """Nut threading with state observations (20-dim) and the 4-dim
+    continuous gripper action; factory name ``jax:nut``."""
+
+    max_episode_steps = 200
+
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(20,), dtype=np.dtype(np.float32), name="state"),
+        action=ArraySpec(shape=(4,), dtype=np.dtype(np.float32), name="hand"),
+    )
+
+    def reset(self, key: jax.Array):
+        k_nut, k_grip, k_w = jax.random.split(key, 3)
+        nut_x = jax.random.uniform(
+            k_nut, (), jnp.float32, _NUT_SPAWN_X[0], _NUT_SPAWN_X[1]
+        )
+        nut_y = jax.random.uniform(
+            jax.random.fold_in(k_nut, 1), (), jnp.float32,
+            -_NUT_SPAWN_Y, _NUT_SPAWN_Y,
+        )
+        grip_xy = jax.random.uniform(k_grip, (2,), jnp.float32, -0.02, 0.02)
+        width0 = jax.random.uniform(
+            k_w, (), jnp.float32, 2.0 * _BLOCK_HALF - 0.005, _GRIP_W_MAX
+        )
+        hand = LiftState(
+            grip_pos=jnp.concatenate(
+                [grip_xy, jnp.full((1,), 0.20, jnp.float32)]
+            ),
+            grip_vel=jnp.zeros((3,), jnp.float32),
+            grip_width=width0,
+            block_pos=jnp.stack([nut_x, nut_y, jnp.asarray(_BLOCK_HALF)]),
+            block_vel=jnp.zeros((3,), jnp.float32),
+        )
+        state = NutState(hand=hand, threaded=jnp.asarray(False))
+        return state, self._obs(state)
+
+    def step(self, state: NutState, action: jax.Array):
+        a = jnp.clip(action, -1.0, 1.0)
+        v_cmd = a[:3] * _GRIP_V_MAX
+        w_rate = -a[3] * _GRIP_W_SPEED
+
+        def substep(carry, _):
+            s, threaded = carry
+            new_gpos = jnp.clip(
+                s.grip_pos + v_cmd * _DT,
+                jnp.array([-_WS_XY, -_WS_XY, 0.0], jnp.float32),
+                jnp.array([_WS_XY, _WS_XY, _WS_Z_MAX], jnp.float32),
+            )
+            gvel = (new_gpos - s.grip_pos) / _DT
+            new_w = jnp.clip(s.grip_width + w_rate * _DT, 0.0, _GRIP_W_MAX)
+            s = s._replace(grip_pos=new_gpos, grip_vel=gvel, grip_width=new_w)
+
+            f_n, contact = _grasp_force(s)
+            support = _MU * f_n / (_BLOCK_MASS * _G)
+            held = contact & (support >= 1.0)
+            # a firm regrasp pulls the nut back OFF the peg
+            threaded = threaded & ~held
+
+            slip_acc = (
+                jnp.array([0.0, 0.0, -_G], jnp.float32)
+                * (1.0 - jnp.minimum(support, 1.0))
+                + (gvel - s.block_vel) * _SLIP_DRAG * jnp.minimum(support, 1.0)
+            )
+            free_acc = jnp.array([0.0, 0.0, -_G], jnp.float32)
+            bvel = jnp.where(
+                held,
+                gvel,
+                s.block_vel + jnp.where(contact, slip_acc, free_acc) * _DT,
+            )
+            bpos = s.block_pos + bvel * _DT
+
+            # threading: released inside the capture radius below the peg
+            # top -> the nut is on the post and slides down it. The
+            # airborne gate (z above table rest height) means the nut must
+            # come DOWN over the post — sliding it along the table into
+            # the capture radius cannot thread it.
+            over_peg = (
+                (jnp.linalg.norm(bpos[:2] - PEG_XY) < PEG_CAPTURE_R)
+                & (bpos[2] < PEG_HEIGHT + _BLOCK_HALF)
+                & (bpos[2] > _BLOCK_HALF + 1e-3)
+            )
+            threaded = threaded | (over_peg & ~held)
+            # on the post: xy clamped to the axis; falls to rest at base
+            bpos = jnp.where(
+                threaded, bpos.at[:2].set(PEG_XY), bpos
+            )
+            bvel = jnp.where(
+                threaded, bvel.at[:2].set(0.0), bvel
+            )
+
+            on_table = bpos[2] <= _BLOCK_HALF
+            bpos = bpos.at[2].set(jnp.maximum(bpos[2], _BLOCK_HALF))
+            bvel = bvel.at[2].set(
+                jnp.where(on_table, jnp.maximum(bvel[2], 0.0), bvel[2])
+            )
+            decay = jnp.exp(-_TABLE_FRICTION * _DT)
+            bvel = bvel.at[:2].multiply(
+                jnp.where(on_table & ~held, decay, 1.0)
+            )
+            bpos = bpos.at[:2].set(jnp.clip(bpos[:2], -_TABLE_XY, _TABLE_XY))
+            return (s._replace(block_pos=bpos, block_vel=bvel), threaded), None
+
+        (hand, threaded), _ = jax.lax.scan(
+            substep, (state.hand, state.threaded), None, length=_N_SUB
+        )
+        state = NutState(hand=hand, threaded=threaded)
+
+        f_n, _ = _grasp_force(hand)
+        support = _MU * f_n / (_BLOCK_MASS * _G)
+        grasped = support >= 1.0
+        dist_reach = jnp.linalg.norm(hand.grip_pos - hand.block_pos)
+        hover = jnp.concatenate(
+            [PEG_XY, jnp.full((1,), PEG_HEIGHT + _BLOCK_HALF + _HOVER)]
+        )
+        dist_carry = jnp.linalg.norm(hand.block_pos - hover)
+        at_rest = hand.block_pos[2] <= _BLOCK_HALF + 1e-4
+        success = threaded & at_rest
+        reward = (
+            (1.0 - jnp.tanh(10.0 * dist_reach))
+            + 0.5 * jnp.minimum(support, 1.0)
+            + 2.0 * (1.0 - jnp.tanh(5.0 * dist_carry))
+            + 2.5 * threaded.astype(jnp.float32)
+        ).astype(jnp.float32)
+        done = jnp.asarray(False)  # time-limit truncation only (AutoReset)
+        info = {
+            "success": success,
+            "grasped": grasped,
+            "threaded": threaded,
+            "nut_height": hand.block_pos[2] - _BLOCK_HALF,
+        }
+        return state, self._obs(state), reward, done, info
+
+    @staticmethod
+    def _obs(state: NutState) -> jax.Array:
+        hand = state.hand
+        peg_top = jnp.concatenate(
+            [PEG_XY, jnp.full((1,), PEG_HEIGHT, jnp.float32)]
+        )
+        return jnp.concatenate(
+            [
+                hand.grip_pos,
+                hand.grip_vel,
+                hand.grip_width[None],
+                hand.block_pos,
+                hand.block_vel,
+                hand.block_pos - hand.grip_pos,
+                peg_top - hand.block_pos,
+                state.threaded.astype(jnp.float32)[None],
+            ]
+        ).astype(jnp.float32)

@@ -511,3 +511,170 @@ def test_dm_control_adapter_batched_cheetah():
     assert out.reward.shape == (2,)
     assert not out.done.any()  # cheetah runs 1000 steps before the limit
     assert np.isfinite(out.obs).all()
+
+
+# -- jax:nut / pixel variants (config-④ workload class) ----------------------
+
+def _nut_scripted_action(state):
+    """Reach -> close -> carry to the hover point -> release over the peg
+    -> retreat; sanity-checks the staged physics admits the solution."""
+    from surreal_tpu.envs.jax.nut_assembly import PEG_HEIGHT, PEG_XY
+    from surreal_tpu.envs.jax.lift import _BLOCK_HALF
+
+    hand = state.hand
+    rel = hand.block_pos - hand.grip_pos
+    d_xy = jnp.linalg.norm(rel[:2])
+    d = jnp.linalg.norm(rel)
+    near_xy = d_xy < 0.01
+    at_nut = d < 0.015
+    # lift-style reach/close
+    vx = jnp.clip(rel[0] * 20, -1, 1)
+    vy = jnp.clip(rel[1] * 20, -1, 1)
+    target_z = jnp.where(near_xy, hand.block_pos[2], 0.08)
+    vz = jnp.clip((target_z - hand.grip_pos[2]) * 20, -1, 1)
+    grip = jnp.where(at_nut, 1.0, -1.0)
+    closed = hand.grip_width < 0.045
+    holding = closed & (d < 0.03)
+    # carry: ascend to hover height first, then translate over the peg
+    hover_z = PEG_HEIGHT + _BLOCK_HALF + 0.04
+    to_peg = jnp.asarray(PEG_XY) - hand.grip_pos[:2]
+    below_hover = hand.grip_pos[2] < hover_z - 0.005
+    vx = jnp.where(holding, jnp.where(below_hover, 0.0, jnp.clip(to_peg[0] * 20, -1, 1)), vx)
+    vy = jnp.where(holding, jnp.where(below_hover, 0.0, jnp.clip(to_peg[1] * 20, -1, 1)), vy)
+    vz = jnp.where(holding, jnp.where(below_hover, 1.0, 0.0), vz)
+    # release: once the NUT is over the peg at height, hold the hand still
+    # and keep the fingers opening (a holding/closed predicate would flip
+    # as the grip loosens and re-close — observed oscillation)
+    nut_over_peg = (
+        jnp.linalg.norm(hand.block_pos[:2] - jnp.asarray(PEG_XY)) < 0.010
+    ) & (hand.block_pos[2] > _BLOCK_HALF + 0.01)
+    vx = jnp.where(nut_over_peg, 0.0, vx)
+    vy = jnp.where(nut_over_peg, 0.0, vy)
+    vz = jnp.where(nut_over_peg, 0.0, vz)
+    grip = jnp.where(nut_over_peg, -1.0, grip)
+    # once threaded: let go and retreat upward, do NOT chase the nut
+    threaded = state.threaded
+    vx = jnp.where(threaded, 0.0, vx)
+    vy = jnp.where(threaded, 0.0, vy)
+    vz = jnp.where(threaded, 1.0, vz)
+    grip = jnp.where(threaded, -1.0, grip)
+    return jnp.stack([vx, vy, vz, grip])
+
+
+def test_nut_specs_and_batched_rollout():
+    env = make_env(env_cfg(name="jax:nut", num_envs=8))
+    assert is_jax_env(env)
+    assert env.specs.obs.shape == (20,)
+    assert env.specs.action.shape == (4,)
+    keys = jax.random.split(jax.random.key(0), 8)
+    state, obs = batch_reset(env, keys)
+
+    @jax.jit
+    def rollout(state, key):
+        def step(carry, _):
+            st, k = carry
+            k, sub = jax.random.split(k)
+            actions = jax.random.uniform(sub, (8, 4), jnp.float32, -1, 1)
+            st, obs, rew, done, info = batch_step(env, st, actions)
+            return (st, k), (obs, rew, done)
+
+        return jax.lax.scan(step, (state, key), None, length=50)
+
+    _, (obss, rews, dones) = rollout(state, jax.random.key(1))
+    assert obss.shape == (50, 8, 20)
+    assert bool(jnp.isfinite(obss).all())
+    assert bool(jnp.isfinite(rews).all())
+    assert not bool(dones.any())
+
+
+def test_nut_scripted_policy_threads_and_succeeds():
+    """The staged physics must admit the intended solution: grasp the nut,
+    carry it above the peg, release -> it threads and rests -> success."""
+    from surreal_tpu.envs.jax.nut_assembly import NutAssembly
+
+    env = NutAssembly()
+    state, _ = env.reset(jax.random.key(5))
+    step = jax.jit(env.step)
+    total = 0.0
+    last_info = None
+    for _ in range(200):
+        state, obs, rew, done, info = step(state, _nut_scripted_action(state))
+        total += float(rew)
+        last_info = info
+    assert bool(last_info["threaded"])
+    assert bool(last_info["success"])
+    assert total > 250.0
+
+
+def test_nut_cannot_thread_by_table_slide():
+    """The airborne gate: a nut RESTING at the peg's xy cannot be
+    threaded — threading requires coming down over the post."""
+    from surreal_tpu.envs.jax.nut_assembly import PEG_XY, NutAssembly, NutState
+    from surreal_tpu.envs.jax.lift import _BLOCK_HALF
+
+    env = NutAssembly()
+    state, _ = env.reset(jax.random.key(6))
+    hand = state.hand._replace(
+        block_pos=jnp.asarray([PEG_XY[0], PEG_XY[1], _BLOCK_HALF], jnp.float32),
+        block_vel=jnp.zeros(3, jnp.float32),
+        grip_pos=jnp.asarray([-0.2, -0.2, 0.3], jnp.float32),  # hand far away
+    )
+    state = NutState(hand=hand, threaded=jnp.asarray(False))
+    step = jax.jit(env.step)
+    for _ in range(20):
+        state, obs, rew, done, info = step(
+            state, jnp.zeros(4, jnp.float32)
+        )
+    assert not bool(info["threaded"])
+    assert not bool(info["success"])
+
+
+def test_pixel_envs_render_scene_and_motion_channels():
+    """Device pixel variants: [64,64,4] uint8 obs; fingers/object/peg draw
+    at their intensities; channels 2:4 are the PREVIOUS frame (motion)."""
+    env = make_env(env_cfg(name="jax:nut_pixels", num_envs=2))
+    assert env.specs.obs.shape == (64, 64, 4)
+    assert env.specs.obs.dtype == np.dtype(np.uint8)
+    keys = jax.random.split(jax.random.key(0), 2)
+    state, obs = batch_reset(env, keys)
+    frame = np.asarray(obs[0])
+    assert frame.dtype == np.uint8
+    vals = set(np.unique(frame).tolist())
+    assert 255 in vals  # fingers
+    assert 170 in vals  # nut
+    assert 110 in vals  # peg
+    # reset: prev == current
+    np.testing.assert_array_equal(frame[..., :2], frame[..., 2:])
+    # step with a moving hand: current differs from prev somewhere
+    a = jnp.tile(jnp.asarray([1.0, 0.0, -0.5, 0.0]), (2, 1))
+    state, obs2, *_ = batch_step(env, state, a)
+    obs2 = np.asarray(obs2[0])
+    np.testing.assert_array_equal(obs2[..., 2:], frame[..., :2])  # prev = old current
+    assert (obs2[..., :2] != obs2[..., 2:]).any()
+
+
+def test_ppo_cnn_trains_on_nut_pixels():
+    """Config-④ shape end-to-end on device: manipulation pixels ->
+    NatureCNN -> PPO in the fused Trainer; two iterations, finite losses."""
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=16, epochs=2, num_minibatches=2),
+            model=Config(cnn=Config(enabled=True, dense=64)),
+        ),
+        env_config=Config(name="jax:nut_pixels", num_envs=8),
+        session_config=Config(
+            folder="/tmp/test_ppo_nut_pixels",
+            total_env_steps=16 * 8 * 2,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    assert trainer.device_mode
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/pg"])
+    assert np.isfinite(metrics["loss/value"])
