@@ -43,18 +43,22 @@ class Evaluator:
         # ``eval_config.max_steps`` overrides the per-episode step cap
         # (default: env time limit on device, 10k on host)
         cap = eval_config.get("max_steps", None)
+        if cap is not None and int(cap) < 1:
+            raise ValueError(f"eval max_steps must be >= 1, got {cap}")
         # eval owns its env instance; host eval uses `episodes` parallel envs
         probe = make_env(env_config)
         if is_jax_env(probe):
             self.env = probe
-            self._time_limit = int(cap) if cap else (self.env.time_limit or 1000)
+            self._time_limit = (
+                int(cap) if cap is not None else (self.env.time_limit or 1000)
+            )
             self._jax_eval = jax.jit(self._device_eval)
         else:
             probe.close()
             self.env = make_env(
                 Config(num_envs=self.episodes).extend(env_config)
             )
-            self._time_limit = int(cap) if cap else 10_000
+            self._time_limit = int(cap) if cap is not None else 10_000
             self._host_act = jax.jit(self.agent.act)  # one cache for all evals
 
     # -- device path ---------------------------------------------------------

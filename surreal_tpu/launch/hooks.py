@@ -89,6 +89,21 @@ class SessionHooks:
         """Latest synced train metrics merged with latest eval metrics."""
         return {**self._last_train, **self._last_eval}
 
+    def final_metrics(self, env_steps: int, extras=None) -> None:
+        """Refresh the trailing metrics snapshot at run end. Drivers whose
+        loop can consume env-step budget WITHOUT a metrics-cadence fire
+        (the SEED drop path discards stale chunks but counts their steps)
+        call this so ``last_metrics``/the writer reflect where the run
+        actually ended, not the last learn."""
+        m = dict(self._last_train)
+        m.update({k: float(v) for k, v in (extras or {}).items()})
+        m["time/env_steps"] = env_steps
+        m["time/env_steps_per_s"] = (env_steps - self._steps0) / max(
+            time.time() - (self._t0 or time.time()), 1e-9
+        )
+        self._last_train = m
+        self.writer.write(env_steps, m)
+
     # -- restore -------------------------------------------------------------
     def restore(self, init_state):
         """-> (state, start_iteration, start_env_steps).
@@ -241,7 +256,11 @@ class SessionHooks:
         self.writer.close()
 
 
-def host_metrics(metrics, recent_returns, window: int = 20):
+HOST_METRICS_WINDOW = 20  # rolling episode-return window; host loops size
+                          # their deque(maxlen=...) with this
+
+
+def host_metrics(metrics, recent_returns, window: int = HOST_METRICS_WINDOW):
     """Deferred host-metrics assembly for host-env loops: the learner's
     metric scalars plus a rolling-mean ``episode/return`` from the env
     wrappers' completed-episode stats. Returns a zero-arg callable for

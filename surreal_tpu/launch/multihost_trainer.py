@@ -241,7 +241,9 @@ class MultiHostTrainer(Trainer):
                 )
                 from collections import deque
 
-                recent_returns: deque = deque(maxlen=20)  # host_metrics window
+                from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
+
+                recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
                 while env_steps < total:
                     key, r_key, l_key, hk_key = jax.random.split(key, 4)
                     # act against a host-local param copy (the SEED host

@@ -390,7 +390,11 @@ class OffPolicyTrainer:
         else:
             host_tail = None
 
-        recent_returns: list = []
+        from collections import deque
+
+        from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
+
+        recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
         first_chunk = True
         while env_steps < total:
             steps = []

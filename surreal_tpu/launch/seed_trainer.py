@@ -306,6 +306,20 @@ class SEEDTrainer:
                 )
                 if stop_flag:
                     break
+            # the drop path consumes budget without firing the metrics
+            # cadence; reconcile the trailing snapshot with reality (only
+            # when it actually trails — an unconditional flush would
+            # duplicate the final writer row at every_n_iters=1)
+            if hooks.last_metrics.get("time/env_steps") != env_steps:
+                hooks.final_metrics(
+                    env_steps,
+                    {
+                        "staleness/dropped_chunks": float(dropped_stale),
+                        "staleness/steps_discarded": float(discarded_steps),
+                        "workers/respawns": float(respawns),
+                        **server.queue_stats(),
+                    },
+                )
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:

@@ -147,7 +147,9 @@ class Trainer:
                 obs = self.env.reset(seed=self.config.env_config.seed)
                 from collections import deque
 
-                recent_returns = deque(maxlen=20)  # host_metrics window
+                from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
+
+                recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
                 while env_steps < total:
                     key, r_key, l_key, hk_key = jax.random.split(key, 4)
                     obs, batch, ep_stats = host_rollout(
