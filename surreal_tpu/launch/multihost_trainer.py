@@ -49,6 +49,7 @@ import jax
 import numpy as np
 
 from surreal_tpu.launch.hooks import SessionHooks, host_metrics
+from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
 from surreal_tpu.launch.rollout import host_rollout, init_device_carry
 from surreal_tpu.launch.trainer import Trainer
 from surreal_tpu.parallel.mesh import check_dp_divisible, replicate_state
@@ -64,56 +65,22 @@ def _to_host_local(tree):
     return jax.tree.map(np.asarray, tree)
 
 
-class MultiHostTrainer(Trainer):
-    """On-policy multi-controller trainer (PPO / IMPALA families).
+class _MultiHostSession:
+    """The multi-controller session discipline shared by every multi-host
+    driver: rank bookkeeping, restore-and-broadcast, and the once-compiled
+    cross-rank stop agreement. Mixed into a Trainer-family class that sets
+    ``self.mesh`` before the mixin methods run."""
 
-    Requires ``jax.distributed`` to be initialized first
-    (``parallel.multihost.initialize_from_topology``) so ``jax.devices()``
-    spans all hosts; ``Trainer.__init__`` then builds the GLOBAL mesh and
-    the dp train step with no multi-host-specific code.
-    """
-
-    def __init__(self, config):
+    def _init_multihost(self, kind: str) -> None:
         self.rank = jax.process_index()
         self.nprocs = jax.process_count()
         self._agree_fn = None
         self._agree_sharding = None
         if self.nprocs < 2:
             raise ValueError(
-                "MultiHostTrainer needs an initialized multi-process runtime "
-                "(jax.process_count() >= 2); use Trainer for single-host runs"
+                f"{kind} needs an initialized multi-process runtime "
+                "(jax.process_count() >= 2); use the single-host driver"
             )
-        global_envs = config.env_config.num_envs
-        check_dp_divisible(
-            global_envs, self.nprocs, "num_envs", "the process count"
-        )
-        self.global_num_envs = global_envs
-        self.local_num_envs = global_envs // self.nprocs
-        if config.env_config.name.startswith("jax:"):
-            # device envs are global: the carry is one dp-sharded array, so
-            # Trainer.__init__ sees the GLOBAL batch width (its dp check
-            # must hold globally); carry creation is overridden in run()
-            super().__init__(config)
-        else:
-            # host-env adapters size their worker batch from num_envs:
-            # each process builds only ITS slice of the global env batch
-            local_cfg = Config(
-                env_config=Config(num_envs=self.local_num_envs)
-            ).extend(config)
-            super().__init__(local_cfg)
-            # ...but step accounting stays global
-            self.num_envs = self.global_num_envs
-            self.config = config
-        if self.device_mode:
-            if self.mesh.size == 1:
-                raise ValueError("multi-host run resolved a size-1 mesh")
-        else:
-            from surreal_tpu.parallel.dp import dp_learn
-            from surreal_tpu.parallel.mesh import make_mesh
-
-            self.mesh = make_mesh(config.session_config.topology)
-            check_dp_divisible(global_envs, self.mesh.shape["dp"])
-            self._learn = dp_learn(self.learner, self.mesh)
 
     # -- rank-0 session services + cross-rank agreement ---------------------
     def _broadcast_from_rank0(self, state, iteration: int, env_steps: int):
@@ -164,6 +131,95 @@ class MultiHostTrainer(Trainer):
         flags = jax.make_array_from_process_local_data(self._agree_sharding, local)
         return bool(self._agree_fn(flags))
 
+    def _maybe_agree_stop(self, iteration: int, stop: bool, metrics_every: int) -> bool:
+        """A stop can only originate on metrics-cadence iterations (rank
+        0's hooks gate ``on_metrics`` behind the metrics fire), and every
+        rank computes that cadence locally — so the cross-host agreement
+        runs only there and the hot loop stays async otherwise. Mirrors
+        PeriodicTracker: fires when iteration % period == 0."""
+        if iteration % metrics_every != 0:
+            return False
+        return self._agree_stop(stop)
+
+    def _begin_session(self, state):
+        """Rank-0 session prologue shared by every multi-host run():
+        restore on rank 0 -> broadcast to all ranks -> replicate over the
+        mesh -> start counters. Returns (hooks, state, iteration,
+        env_steps); hooks is None on ranks > 0."""
+        hooks = SessionHooks(self.config, self.learner) if self.rank == 0 else None
+        try:
+            iteration, env_steps = 0, 0
+            if hooks is not None:
+                state, iteration, env_steps = hooks.restore(state)
+            state, iteration, env_steps = self._broadcast_from_rank0(
+                state, iteration, env_steps
+            )
+            state = replicate_state(self.mesh, state)
+            if hooks is not None:
+                hooks.begin_run(iteration, env_steps)
+        except BaseException:
+            # the caller only closes hooks it received; a prologue failure
+            # must not leak the writer/checkpoint manager
+            if hooks is not None:
+                hooks.close()
+            raise
+        return hooks, state, iteration, env_steps
+
+    def _end_session(self, hooks, iteration: int, env_steps: int, lazy_host_state):
+        """Run-end epilogue: rank 0 writes the final checkpoint, then ALL
+        ranks leave the collective schedule together (rank 0 may still be
+        writing while others would otherwise tear down the runtime)."""
+        if hooks is not None:
+            hooks.final_checkpoint(iteration, env_steps, lazy_host_state)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("surreal_tpu:run_end")
+        return hooks.last_metrics if hooks is not None else {}
+
+
+class MultiHostTrainer(_MultiHostSession, Trainer):
+    """On-policy multi-controller trainer (PPO / IMPALA families).
+
+    Requires ``jax.distributed`` to be initialized first
+    (``parallel.multihost.initialize_from_topology``) so ``jax.devices()``
+    spans all hosts; ``Trainer.__init__`` then builds the GLOBAL mesh and
+    the dp train step with no multi-host-specific code.
+    """
+
+    def __init__(self, config):
+        self._init_multihost("MultiHostTrainer")
+        global_envs = config.env_config.num_envs
+        check_dp_divisible(
+            global_envs, self.nprocs, "num_envs", "the process count"
+        )
+        self.global_num_envs = global_envs
+        self.local_num_envs = global_envs // self.nprocs
+        if config.env_config.name.startswith("jax:"):
+            # device envs are global: the carry is one dp-sharded array, so
+            # Trainer.__init__ sees the GLOBAL batch width (its dp check
+            # must hold globally); carry creation is overridden in run()
+            super().__init__(config)
+        else:
+            # host-env adapters size their worker batch from num_envs:
+            # each process builds only ITS slice of the global env batch
+            local_cfg = Config(
+                env_config=Config(num_envs=self.local_num_envs)
+            ).extend(config)
+            super().__init__(local_cfg)
+            # ...but step accounting stays global
+            self.num_envs = self.global_num_envs
+            self.config = config
+        if self.device_mode:
+            if self.mesh.size == 1:
+                raise ValueError("multi-host run resolved a size-1 mesh")
+        else:
+            from surreal_tpu.parallel.dp import dp_learn
+            from surreal_tpu.parallel.mesh import make_mesh
+
+            self.mesh = make_mesh(config.session_config.topology)
+            check_dp_divisible(global_envs, self.mesh.shape["dp"])
+            self._learn = dp_learn(self.learner, self.mesh)
+
     # -- main loop -----------------------------------------------------------
     def run(
         self,
@@ -177,33 +233,17 @@ class MultiHostTrainer(Trainer):
         cfg = self.config.session_config
         total = max_env_steps or cfg.total_env_steps
         steps_per_iter = self.horizon * self.global_num_envs
-        # A stop can only originate on metrics-cadence iterations (rank 0's
-        # hooks gate on_metrics behind the metrics fire), and EVERY rank can
-        # compute that cadence locally — so the cross-host stop agreement
-        # runs only on those iterations and the hot loop stays async the
-        # rest of the time. Mirrors PeriodicTracker: count == iteration,
-        # fires when iteration % period == 0.
         metrics_every = max(1, cfg.metrics.every_n_iters)
 
         def maybe_agree_stop(iteration: int, stop: bool) -> bool:
-            if iteration % metrics_every != 0:
-                return False
-            return self._agree_stop(stop)
+            return self._maybe_agree_stop(iteration, stop, metrics_every)
 
         key = jax.random.key(self.seed)  # identical chain on every rank
         key, init_key, env_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
-        hooks = SessionHooks(self.config, self.learner) if self.rank == 0 else None
+        hooks = None
         try:
-            iteration, env_steps = 0, 0
-            if hooks is not None:
-                state, iteration, env_steps = hooks.restore(state)
-            state, iteration, env_steps = self._broadcast_from_rank0(
-                state, iteration, env_steps
-            )
-            state = replicate_state(self.mesh, state)
-            if hooks is not None:
-                hooks.begin_run(iteration, env_steps)
+            hooks, state, iteration, env_steps = self._begin_session(state)
 
             def lazy_host_state():
                 return _to_host_local(state)
@@ -273,14 +313,115 @@ class MultiHostTrainer(Trainer):
                         )
                     if maybe_agree_stop(iteration, stop):
                         break
+            return state, self._end_session(
+                hooks, iteration, env_steps, lazy_host_state
+            )
+        finally:
             if hooks is not None:
-                hooks.final_checkpoint(iteration, env_steps, lazy_host_state)
-            from jax.experimental import multihost_utils
+                hooks.close()
 
-            # leave together: rank 0 may still be writing the final
-            # checkpoint while others would otherwise tear down the runtime
-            multihost_utils.sync_global_devices("surreal_tpu:run_end")
-            return state, (hooks.last_metrics if hooks is not None else {})
+
+class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
+    """Off-policy (DDPG-family) multi-controller trainer: the same global
+    mesh discipline as :class:`MultiHostTrainer`, with the replay data
+    plane sharded across EVERY device of EVERY host (replay/sharded.py —
+    the reference's ShardedReplay scaled past one machine; each host's
+    devices hold their own buffer shards and sample locally, the gradient
+    psum fans in across hosts).
+
+    Device (``jax:*``) envs only: the fused rollout+replay+update program
+    is one SPMD computation over the global mesh. Host-env off-policy
+    stays single-controller (its replay lives on one host's devices) —
+    the launcher routes that combination to OffPolicyTrainer.
+    """
+
+    def __init__(self, config):
+        self._init_multihost("MultiHostOffPolicyTrainer")
+        if not config.env_config.name.startswith("jax:"):
+            raise ValueError(
+                "multi-host off-policy training needs a device env "
+                f"(jax:*); got {config.env_config.name!r} — host-env "
+                "off-policy runs single-host (replay on one host)"
+            )
+        check_dp_divisible(
+            config.env_config.num_envs, self.nprocs,
+            "num_envs", "the process count",
+        )
+        # OffPolicyTrainer.__init__ builds the GLOBAL mesh (jax.devices()
+        # spans hosts once jax.distributed is up), the per-device-scaled
+        # replay, and the dp_offpolicy_iter shard_map — unchanged.
+        super().__init__(config)
+        if self.mesh is None or self.mesh.size == 1:
+            raise ValueError("multi-host run resolved a size-1 mesh")
+
+    def run(
+        self,
+        max_env_steps: int | None = None,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from surreal_tpu.parallel.dp import offpolicy_carry_specs
+        from surreal_tpu.replay.sharded import sharded_replay_init
+
+        cfg = self.config.session_config
+        total = max_env_steps or cfg.total_env_steps
+        steps_per_iter = self.horizon * self.num_envs
+        metrics_every = max(1, cfg.metrics.every_n_iters)
+
+        key = jax.random.key(self.seed)  # identical chain on every rank
+        key, init_key, env_key = jax.random.split(key, 3)
+        state = self.learner.init(init_key)
+        hooks = None
+        try:
+            hooks, state, iteration, env_steps = self._begin_session(state)
+
+            def lazy_host_state():
+                return _to_host_local(state)
+
+            # SPMD carry init: one jitted program over the global mesh;
+            # each process materializes only its addressable env shards.
+            carry_shapes = jax.eval_shape(self._init_carry, env_key)
+            carry_sh = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                offpolicy_carry_specs(carry_shapes, "dp"),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            carry = jax.jit(self._init_carry, out_shardings=carry_sh)(env_key)
+            # replay shards allocate per-device via shard_map (SPMD too)
+            replay_state = sharded_replay_init(
+                self.replay, self._replay_example(), self.mesh
+            )
+
+            first_call = True
+            import jax.numpy as jnp
+
+            while env_steps < total:
+                key, it_key, hk_key = jax.random.split(key, 3)
+                # beta/warmup derive from env_steps, identical on every
+                # rank (same counter chain) -> consistent replicated inputs
+                beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
+                warmup = jnp.asarray(
+                    env_steps < self.algo.exploration.warmup_steps
+                )
+                state, replay_state, carry, metrics = self._train_iter(
+                    state, replay_state, carry, it_key, beta, warmup,
+                    jnp.asarray(first_call),
+                )
+                first_call = False
+                iteration += 1
+                env_steps += steps_per_iter
+                stop = False
+                if hooks is not None:
+                    _, stop = hooks.end_iteration(
+                        iteration, env_steps, lazy_host_state, hk_key,
+                        metrics, on_metrics,
+                    )
+                if self._maybe_agree_stop(iteration, stop, metrics_every):
+                    break
+            return state, self._end_session(
+                hooks, iteration, env_steps, lazy_host_state
+            )
         finally:
             if hooks is not None:
                 hooks.close()

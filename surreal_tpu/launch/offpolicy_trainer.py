@@ -111,6 +111,49 @@ class OffPolicyTrainer:
                 self._update_prio = jax.jit(self.replay.update_priorities)
 
     # -- device (fused) path -------------------------------------------------
+    def _init_carry(self, env_key: jax.Array) -> OffPolicyCarry:
+        """Fresh rollout carry for ``num_envs`` envs. Pure and jittable —
+        the multi-host driver runs it under jit with dp out-shardings so
+        each process materializes only its addressable env shards."""
+        act_dim = int(self.env.specs.action.shape[0])
+        keys = jax.random.split(env_key, self.num_envs)
+        env_state, obs = jax.vmap(self.env.reset)(keys)
+        n = self.algo.n_step
+        if n > 1:
+            B = self.num_envs
+            obs_shape = self.env.specs.obs.shape
+            tail = {
+                "obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
+                "next_obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
+                "action": jnp.zeros((n - 1, B, act_dim), jnp.float32),
+                "reward": jnp.zeros((n - 1, B), jnp.float32),
+                # done=True + terminated=True: windows starting in the
+                # fake prefix die at once with reward 0 and discount 0
+                "done": jnp.ones((n - 1, B), bool),
+                "terminated": jnp.ones((n - 1, B), bool),
+            }
+        else:
+            tail = None
+        return OffPolicyCarry(
+            env_state=env_state,
+            obs=obs,
+            noise=jnp.zeros((self.num_envs, act_dim), jnp.float32),
+            ep_return=jnp.zeros(self.num_envs, jnp.float32),
+            ep_length=jnp.zeros(self.num_envs, jnp.int32),
+            tail=tail,
+        )
+
+    def _replay_example(self) -> dict:
+        """Single-transition example pytree sizing the replay storage."""
+        act_dim = int(self.env.specs.action.shape[0])
+        return {
+            "obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
+            "next_obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
+            "action": jnp.zeros((act_dim,), jnp.float32),
+            "reward": jnp.zeros((), jnp.float32),
+            "discount": jnp.zeros((), jnp.float32),
+        }
+
     def _rollout(self, state, carry: OffPolicyCarry, key: jax.Array, warmup):
         explo = self.algo.exploration
 
@@ -267,7 +310,6 @@ class OffPolicyTrainer:
         cfg = self.config.session_config
         total = max_env_steps or cfg.total_env_steps
         steps_per_iter = self.horizon * self.num_envs
-        act_dim = int(self.env.specs.action.shape[0])
 
         key = jax.random.key(self.seed)
         key, init_key, env_key = jax.random.split(key, 3)
@@ -284,42 +326,8 @@ class OffPolicyTrainer:
                 from surreal_tpu.parallel.mesh import replicate_state
 
                 state = replicate_state(self.mesh, state)
-            keys = jax.random.split(env_key, self.num_envs)
-            env_state, obs = jax.vmap(self.env.reset)(keys)
-            n = self.algo.n_step
-            if n > 1:
-                B = self.num_envs
-                obs_shape = self.env.specs.obs.shape
-                tail = {
-                    "obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
-                    "next_obs": jnp.zeros((n - 1, B, *obs_shape), jnp.float32),
-                    "action": jnp.zeros((n - 1, B, act_dim), jnp.float32),
-                    "reward": jnp.zeros((n - 1, B), jnp.float32),
-                    # done=True + terminated=True: windows starting in the
-                    # fake prefix die at once with reward 0 and discount 0
-                    "done": jnp.ones((n - 1, B), bool),
-                    "terminated": jnp.ones((n - 1, B), bool),
-                }
-            else:
-                tail = None
-            carry = OffPolicyCarry(
-                env_state=env_state,
-                obs=obs,
-                noise=jnp.zeros((self.num_envs, act_dim), jnp.float32),
-                ep_return=jnp.zeros(self.num_envs, jnp.float32),
-                ep_length=jnp.zeros(self.num_envs, jnp.int32),
-                tail=tail,
-            )
-            example = jax.tree.map(
-                lambda x: jnp.zeros(x.shape[2:], x.dtype),
-                {
-                    "obs": jnp.zeros((1, 1, *self.env.specs.obs.shape), jnp.float32),
-                    "next_obs": jnp.zeros((1, 1, *self.env.specs.obs.shape), jnp.float32),
-                    "action": jnp.zeros((1, 1, act_dim), jnp.float32),
-                    "reward": jnp.zeros((1, 1), jnp.float32),
-                    "discount": jnp.zeros((1, 1), jnp.float32),
-                },
-            )
+            carry = self._init_carry(env_key)
+            example = self._replay_example()
             if self.mesh is not None and self.mesh.size > 1:
                 from surreal_tpu.replay.sharded import sharded_replay_init
 
@@ -365,14 +373,7 @@ class OffPolicyTrainer:
 
         key = jax.random.key(self.seed + 1)
         obs = self.env.reset(seed=self.config.env_config.seed)
-        example = {
-            "obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
-            "next_obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
-            "action": jnp.zeros((act_dim,), jnp.float32),
-            "reward": jnp.zeros((), jnp.float32),
-            "discount": jnp.zeros((), jnp.float32),
-        }
-        replay_state = self.replay.init(example)
+        replay_state = self.replay.init(self._replay_example())
         noise = np.zeros((self.num_envs, act_dim), np.float32)
         explo = self.algo.exploration
         n = self.algo.n_step

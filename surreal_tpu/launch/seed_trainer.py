@@ -220,6 +220,19 @@ class SEEDTrainer:
 
             dropped_stale = 0
             respawns = 0
+            discarded_steps = 0
+
+            def data_plane_extras() -> dict:
+                """One source of truth for the drop/eviction/episode
+                accounting, used for every in-loop metrics row AND the
+                run-end reconciliation (keeping the two in lockstep)."""
+                return {
+                    "staleness/dropped_chunks": float(dropped_stale),
+                    "staleness/steps_discarded": float(discarded_steps),
+                    "workers/respawns": float(respawns),
+                    **server.queue_stats(),
+                    **(server.episode_stats() or {}),
+                }
             # the FIRST chunk waits out the policy's XLA compiles plus a
             # full unroll of round trips (can be minutes on a tunneled
             # TPU); workers keep their own 120s liveness budget per step,
@@ -244,7 +257,6 @@ class SEEDTrainer:
                                 "no experience chunks arriving from workers"
                             ) from None
 
-            discarded_steps = 0
             while env_steps < total:
                 chunk = next_chunk(chunk_timeout)
                 chunk_timeout = 30.0
@@ -292,14 +304,8 @@ class SEEDTrainer:
                 )
                 metrics = dict(
                     metrics,
-                    **{
-                        "staleness/updates_behind": float(staleness),
-                        "staleness/dropped_chunks": float(dropped_stale),
-                        "staleness/steps_discarded": float(discarded_steps),
-                        "workers/respawns": float(respawns),
-                    },
-                    **server.queue_stats(),
-                    **(server.episode_stats() or {}),
+                    **{"staleness/updates_behind": float(staleness)},
+                    **data_plane_extras(),
                 )
                 _, stop_flag = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics
@@ -311,15 +317,7 @@ class SEEDTrainer:
             # when it actually trails — an unconditional flush would
             # duplicate the final writer row at every_n_iters=1)
             if hooks.last_metrics.get("time/env_steps") != env_steps:
-                hooks.final_metrics(
-                    env_steps,
-                    {
-                        "staleness/dropped_chunks": float(dropped_stale),
-                        "staleness/steps_discarded": float(discarded_steps),
-                        "workers/respawns": float(respawns),
-                        **server.queue_stats(),
-                    },
-                )
+                hooks.final_metrics(env_steps, data_plane_extras())
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:

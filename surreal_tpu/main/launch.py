@@ -119,16 +119,19 @@ def run_train(args) -> int:
     multihost = initialize_from_topology(config.session_config.topology)
     if multihost:
         algo = config.learner_config.algo.name
+        env_name = config.env_config.name
         workers = config.session_config.topology.num_env_workers
-        if algo == "ddpg" or workers > 0:
-            # fail loudly: the off-policy (per-device replay) and SEED
-            # (inference-server) drivers are single-controller today; the
-            # multi-host loop covers the on-policy families
+        if workers > 0 or (algo == "ddpg" and not env_name.startswith("jax:")):
+            # fail loudly: the SEED (inference-server) driver and host-env
+            # off-policy (replay on one host's devices) are
+            # single-controller; multi-host covers the on-policy families
+            # and device-env off-policy
             raise ValueError(
-                "multi-host training currently supports the on-policy "
-                f"drivers (ppo, impala) without --workers; got algo={algo!r}"
-                f", num_env_workers={workers} — run those single-host, or "
-                "scale them by mesh axes within one host"
+                "multi-host training supports ppo/impala (device or host "
+                "envs) and ddpg on device (jax:*) envs, without --workers; "
+                f"got algo={algo!r}, env={env_name!r}, num_env_workers="
+                f"{workers} — run that combination single-host, or scale "
+                "it by mesh axes within one host"
             )
     import jax
 
@@ -142,9 +145,16 @@ def run_train(args) -> int:
         ) as f:
             f.write(config.dumps())
     if multihost:
-        from surreal_tpu.launch.multihost_trainer import MultiHostTrainer
+        if config.learner_config.algo.name == "ddpg":
+            from surreal_tpu.launch.multihost_trainer import (
+                MultiHostOffPolicyTrainer,
+            )
 
-        trainer = MultiHostTrainer(config)
+            trainer = MultiHostOffPolicyTrainer(config)
+        else:
+            from surreal_tpu.launch.multihost_trainer import MultiHostTrainer
+
+            trainer = MultiHostTrainer(config)
     else:
         trainer = select_trainer(config)
     state, metrics = trainer.run()

@@ -55,6 +55,22 @@ def _spec_like_metrics(spec: P):
     return spec
 
 
+def offpolicy_carry_specs(carry, axis: str = "dp"):
+    """PartitionSpecs for an ``OffPolicyCarry``(-like) pytree: every field
+    is [B, ...] sharded on the env-batch dim except the n-step ``tail``,
+    which is time-major [T, B, ...]. Shared by the shard_map wrapper below
+    and the multi-host driver's SPMD carry init (as jit out-shardings).
+    ``carry`` may be concrete arrays or ShapeDtypeStructs."""
+    return type(carry)(
+        env_state=_spec_like(carry.env_state, P(axis)),
+        obs=P(axis),
+        noise=P(axis),
+        ep_return=P(axis),
+        ep_length=P(axis),
+        tail=None if carry.tail is None else _spec_like(carry.tail, P(None, axis)),
+    )
+
+
 def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
     """Shard the fused off-policy iteration
     ``(state, replay_state, carry, key, beta, warmup) -> (state,
@@ -75,15 +91,7 @@ def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
         )
 
     def carry_specs(carry):
-        # OffPolicyCarry: every field is [B, ...] except tail {k: [T, B, ...]}
-        return type(carry)(
-            env_state=_spec_like(carry.env_state, P(axis)),
-            obs=P(axis),
-            noise=P(axis),
-            ep_return=P(axis),
-            ep_length=P(axis),
-            tail=None if carry.tail is None else _spec_like(carry.tail, P(None, axis)),
-        )
+        return offpolicy_carry_specs(carry, axis)
 
     def wrapped(state, replay_state, carry, key, beta, warmup, first):
         shard = shard_map(
