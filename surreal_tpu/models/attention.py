@@ -1,0 +1,117 @@
+"""Trajectory sequence encoder with a sequence-parallel attention seam.
+
+No reference counterpart (SURVEY.md §5.7: upstream has no attention —
+trajectory handling is windowing + recurrences), but the rebuild treats
+long-context as first-class: this module is the model-layer seam where a
+sequence policy plugs in, and its attention routes through
+``ops/ring_attention.py`` when a mesh is supplied — the time axis shards
+over the ``sp`` mesh axis and K/V blocks ride the ring
+(``ppermute``/ICI), so horizons can grow past one device's HBM without
+touching the module's math.
+
+Use: encode a [B, T, obs] trajectory into [B, T, features] (e.g. an
+attention critic over long horizons, or a trajectory-transformer policy);
+the fused trainers' [T, B, ...] batches transpose in/out at the call
+site. Causal throughout — policies must not see the future.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.models.encoders import orthogonal_init
+from surreal_tpu.ops.ring_attention import full_attention, ring_self_attention
+
+
+class CausalSelfAttention(nn.Module):
+    """Multi-head causal self-attention; single-device full attention by
+    default, ring attention over ``mesh[sp_axis]`` when ``mesh`` is set."""
+
+    num_heads: int = 4
+    head_dim: int = 16
+    mesh: Any = None          # jax.sharding.Mesh (hashable; static attr)
+    sp_axis: str = "sp"
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, T, E = x.shape
+        H, D = self.num_heads, self.head_dim
+        proj = lambda name: nn.DenseGeneral(
+            (H, D), axis=-1, name=name,
+            dtype=self.compute_dtype, param_dtype=self.param_dtype,
+            kernel_init=orthogonal_init(1.0),
+        )
+        q, k, v = proj("q")(x), proj("k")(x), proj("v")(x)
+        if self.mesh is not None:
+            out = ring_self_attention(
+                self.mesh, q, k, v, causal=True, axis=self.sp_axis
+            )
+        else:
+            out = full_attention(q, k, v, causal=True)
+        out = out.reshape(B, T, H * D)
+        return nn.DenseGeneral(
+            E, axis=-1, name="o",
+            dtype=self.compute_dtype, param_dtype=self.param_dtype,
+            kernel_init=orthogonal_init(1.0),
+        )(out)
+
+
+class TrajectoryEncoder(nn.Module):
+    """Small pre-LN causal transformer over a trajectory: [B, T, obs] ->
+    [B, T, features]. Heads (policy/value) attach outside."""
+
+    features: int = 64
+    num_layers: int = 2
+    num_heads: int = 4
+    head_dim: int = 16
+    mesh: Any = None
+    sp_axis: str = "sp"
+    max_len: int = 4096
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> jax.Array:
+        B, T, _ = obs.shape
+        x = nn.Dense(
+            self.features, dtype=self.compute_dtype,
+            param_dtype=self.param_dtype, kernel_init=orthogonal_init(1.0),
+            name="embed",
+        )(obs.astype(self.compute_dtype))
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.features),
+            self.param_dtype,
+        )
+        x = x + pos[:T].astype(self.compute_dtype)[None]
+        for i in range(self.num_layers):
+            h = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_a{i}")(x)
+            x = x + CausalSelfAttention(
+                num_heads=self.num_heads, head_dim=self.head_dim,
+                mesh=self.mesh, sp_axis=self.sp_axis,
+                compute_dtype=self.compute_dtype,
+                param_dtype=self.param_dtype, name=f"attn{i}",
+            )(h)
+            h = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_m{i}")(x)
+            h = nn.Dense(
+                4 * self.features, dtype=self.compute_dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=orthogonal_init(1.0), name=f"mlp_in{i}",
+            )(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(
+                self.features, dtype=self.compute_dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=orthogonal_init(1.0), name=f"mlp_out{i}",
+            )(h)
+        # heads downstream do numerically delicate work in f32
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(
+            x.astype(jnp.float32)
+        )

@@ -1,0 +1,149 @@
+"""Ring attention over a sequence-parallel mesh axis.
+
+The reference has NO attention anywhere (SURVEY.md §2.4/§5.7 — its
+"sequence" machinery is trajectory windowing), so there is nothing to
+port; this module exists because long-context scaling is first-class in
+the TPU rebuild's design: if a sequence model ever joins the policy stack
+(trajectory transformers, attention critics over long horizons), the
+sequence axis must be able to shard past one device's HBM. Ring attention
+is the canonical recipe: each device holds one block of the sequence,
+K/V blocks rotate around the ring via ``lax.ppermute`` (ICI
+neighbor-to-neighbor traffic, no all-gather), and softmax is computed
+ONLINE (flash-style running max/denominator) so the full [T, T] score
+matrix never materializes on any device.
+
+Layout: [B, T, H, D] (batch, time, heads, head dim). Inside
+``shard_map``, T is the LOCAL block; global positions for causal masking
+derive from ``lax.axis_index``. Compute runs in the input dtype (bf16 on
+TPU hits the MXU); the online-softmax statistics are always f32 — running
+max/denominator accumulate across the whole ring and drift in bf16.
+
+Pallas note (SURVEY.md §2.3 kernel policy): within one block this is
+plain XLA einsum — fused well already; the cross-device ring is mesh
+communication, not kernel work. A Pallas flash kernel would slot in at
+``_block_attend`` if per-block HBM traffic ever dominates.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_NEG_BIG = -1e30  # mask value: -inf would propagate NaN through exp(m - m)
+
+
+def _block_attend(q, k, v, mask, m_prev, l_prev, acc_prev, scale):
+    """One flash-attention block update with f32 running statistics.
+
+    q [B,Tq,H,D], k/v [B,Tk,H,D], mask [Tq,Tk] bool (True = attend).
+    Carries: m [B,H,Tq] running max, l [B,H,Tq] running denominator,
+    acc [B,Tq,H,D] unnormalized output accumulator.
+    """
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    m_new = jnp.maximum(m_prev, scores.max(axis=-1))
+    # rescale previous accumulators to the new max
+    correction = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new[..., None])  # [B,H,Tq,Tk] f32
+    l_new = l_prev * correction + p.sum(axis=-1)
+    # flash practice: the p@v contraction runs in the COMPUTE dtype (bf16
+    # operands hit the MXU) while accumulation stays f32
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    acc_new = acc_prev * correction.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, acc_new
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Reference single-device attention (softmax in f32), [B,T,H,D] ->
+    [B,T,H,D]. The golden model ring_attention must match."""
+    B, T, H, D = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Blockwise ring attention; call INSIDE ``shard_map`` with the time
+    axis sharded over ``axis_name``.
+
+    Args: q, k, v [B, T_local, H, D] — this device's sequence block.
+    Returns [B, T_local, H, D], the exact attention output for this block
+    over the FULL (global) sequence.
+
+    K/V rotate one neighbor per step (``ppermute``); after
+    ``axis_size`` steps every device has attended to every block. Causal
+    masking uses global block offsets, so cross-block masks are all-or-
+    nothing except the diagonal block's triangle.
+    """
+    B, T, H, D = q.shape
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+
+    m0 = jnp.full((B, H, T), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    acc0 = jnp.zeros((B, T, H, D), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: shift blocks right
+    tri = jnp.tril(jnp.ones((T, T), bool))
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        # after i rotations this device holds the block originally at
+        # ring position (my - i) mod n
+        src = (my - i) % n
+        if causal:
+            # cross-block causality is all-or-nothing (src block strictly
+            # earlier -> fully visible, strictly later -> fully masked);
+            # only the diagonal block needs the triangle
+            mask = jnp.where(src == my, tri, jnp.broadcast_to(src < my, (T, T)))
+        else:
+            mask = jnp.ones((T, T), bool)
+        m, l, acc = _block_attend(q, k_blk, v_blk, mask, m, l, acc, scale)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    # rows that attended to nothing (can't happen causally: the diagonal
+    # block always contributes) would divide by zero; guard anyway
+    l = jnp.maximum(l, 1e-30)
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _ring_jit(mesh, axis: str, causal: bool):
+    """One compiled ring program per (mesh, axis, causal) — rebuilding the
+    shard_map/jit per call would miss the jit cache and recompile every
+    eager invocation (Mesh is hashable, so it keys the cache directly)."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    attend = shard_map(
+        functools.partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,  # house style (parallel/dp.py): the loop carry
+        # mixes axis-varying (q-derived) and freshly-created accumulators
+    )
+    return jax.jit(attend)
+
+
+def ring_self_attention(mesh, q, k, v, causal: bool = False, axis: str = "sp"):
+    """Host-side convenience: run :func:`ring_attention` under
+    ``shard_map`` with the time axis of [B, T, H, D] inputs sharded over
+    ``mesh[axis]`` (batch/heads replicated — shard those over dp/tp
+    outside if needed)."""
+    return _ring_jit(mesh, axis, causal)(q, k, v)
