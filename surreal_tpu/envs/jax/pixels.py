@@ -167,3 +167,42 @@ class NutAssemblyPixels(_DevicePixels):
     render = staticmethod(render_nut)
     max_episode_steps = NutAssembly.max_episode_steps
     specs = _PIXEL_SPECS(NutAssembly)
+
+
+# -- eval-video frame rendering ---------------------------------------------
+
+def _views_to_rgb(views, upscale: int = 3):
+    """[R, R, 2] two-view uint8 -> side-by-side RGB [R*u, 2*R*u + u, 3]
+    (host numpy; per-frame eval-video work, not a device op)."""
+    import numpy as np
+
+    v = np.asarray(views)
+    sep = np.full((v.shape[0], 1), 40, np.uint8)  # thin divider column
+    panel = np.concatenate([v[..., 0], sep, v[..., 1]], axis=1)
+    panel = panel.repeat(upscale, axis=0).repeat(upscale, axis=1)
+    return np.stack([panel] * 3, axis=-1)
+
+
+def frame_renderer(env):
+    """Optional eval-video renderer for a device env: returns
+    ``state -> [H, W, 3] uint8`` or None when the env has no visual form
+    (the reference recorded eval videos via VideoWrapper; device envs
+    render from state instead of a GL context)."""
+    from surreal_tpu.envs.jax.pong import Pong
+
+    if isinstance(env, _DevicePixels):
+        render = type(env).render
+        return lambda s: _views_to_rgb(render(s.inner))
+    if isinstance(env, BlockLift):
+        return lambda s: _views_to_rgb(render_lift(s))
+    if isinstance(env, NutAssembly):
+        return lambda s: _views_to_rgb(render_nut(s))
+    if isinstance(env, Pong):
+        import numpy as np
+
+        def pong_frame(s):
+            f = np.asarray(s.prev_frame).repeat(4, axis=0).repeat(4, axis=1)
+            return np.stack([f] * 3, axis=-1)
+
+        return pong_frame
+    return None

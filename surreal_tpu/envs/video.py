@@ -63,11 +63,20 @@ class VideoWrapper(HostWrapper):
         return out
 
     def _save(self) -> None:
-        stem = os.path.join(self.out_dir, f"episode_{self._episode:06d}")
-        frames = np.stack(self._frames)
-        try:
-            import imageio.v2 as imageio
+        save_episode_frames(self._frames, self.out_dir, self._episode)
 
-            imageio.mimwrite(stem + ".mp4", frames, fps=30)
-        except Exception:
-            np.savez_compressed(stem + ".npz", frames=frames)
+
+def save_episode_frames(frames, out_dir: str, episode_idx: int) -> str:
+    """Write one episode's frame stack (mp4 when an encoder exists, else
+    .npz). Shared by the host VideoWrapper and the device-env eval
+    recorder. Returns the file stem."""
+    os.makedirs(out_dir, exist_ok=True)
+    stem = os.path.join(out_dir, f"episode_{episode_idx:06d}")
+    arr = np.stack([np.asarray(f) for f in frames])
+    try:
+        import imageio.v2 as imageio
+
+        imageio.mimwrite(stem + ".mp4", arr, fps=30)
+    except Exception:
+        np.savez_compressed(stem + ".npz", frames=arr)
+    return stem

@@ -53,6 +53,17 @@ class Evaluator:
                 int(cap) if cap is not None else (self.env.time_limit or 1000)
             )
             self._jax_eval = jax.jit(self._device_eval)
+            # eval is where the reference recorded videos; device envs
+            # render from state (envs/jax/pixels.py frame_renderer)
+            self._video_cfg = env_config.video
+            self._video_episode = 0
+            if self._video_cfg.enabled and self._video_cfg.dir:
+                # record on the UNWRAPPED env: AutoReset replaces the
+                # terminal state with the next reset state, which would
+                # make the outcome frame (the lift, the thread)
+                # structurally unrecordable
+                self._jit_step1 = jax.jit(self.env.env.step)
+                self._jit_act1 = jax.jit(self.agent.act)
         else:
             probe.close()
             self.env = make_env(
@@ -133,9 +144,37 @@ class Evaluator:
             "eval/success": float(success.astype(np.float32).mean()),
         }
 
+    def _record_device_episode(self, state, key) -> None:
+        """Roll ONE un-vmapped episode with the current policy, rendering
+        each step's state to a frame; honors video.every_n_episodes
+        across evaluate() calls (the eval cadence drives the rest)."""
+        from surreal_tpu.envs.jax.pixels import frame_renderer
+        from surreal_tpu.envs.video import save_episode_frames
+
+        render = frame_renderer(self.env.env)  # unwrap AutoReset
+        episode = self._video_episode
+        self._video_episode += 1
+        if render is None or episode % max(1, self._video_cfg.every_n_episodes):
+            return
+        key, reset_key = jax.random.split(key)
+        env_state, obs = self.env.env.reset(reset_key)  # raw env, no AutoReset
+        frames = [render(env_state)]
+        for _ in range(self._time_limit):
+            key, akey = jax.random.split(key)
+            action, _ = self._jit_act1(state, obs[None], akey)
+            env_state, obs, reward, done, info = self._jit_step1(
+                env_state, action[0]
+            )
+            frames.append(render(env_state))  # includes the terminal frame
+            if bool(done):
+                break
+        save_episode_frames(frames, self._video_cfg.dir, episode)
+
     def evaluate(self, state, key: jax.Array) -> dict[str, float]:
         if self._jax_eval is not None:
             out = self._jax_eval(state, key)
+            if self._video_cfg.enabled and self._video_cfg.dir:
+                self._record_device_episode(state, jax.random.fold_in(key, 7))
             return {k: float(v) for k, v in out.items()}
         return self._host_eval(state, key)
 

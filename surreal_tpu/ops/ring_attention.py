@@ -97,8 +97,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     perm = [(i, (i + 1) % n) for i in range(n)]  # ring: shift blocks right
     tri = jnp.tril(jnp.ones((T, T), bool))
 
-    def body(i, carry):
-        k_blk, v_blk, m, l, acc = carry
+    def attend(i, k_blk, v_blk, m, l, acc):
         # after i rotations this device holds the block originally at
         # ring position (my - i) mod n
         src = (my - i) % n
@@ -109,12 +108,22 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
             mask = jnp.where(src == my, tri, jnp.broadcast_to(src < my, (T, T)))
         else:
             mask = jnp.ones((T, T), bool)
-        m, l, acc = _block_attend(q, k_blk, v_blk, mask, m, l, acc, scale)
+        return _block_attend(q, k_blk, v_blk, mask, m, l, acc, scale)
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, acc = carry
+        m, l, acc = attend(i, k_blk, v_blk, m, l, acc)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m, l, acc
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+    # n-1 attend+rotate rounds, then the last block attends WITHOUT a
+    # final rotation — the n-th ppermute's result would be discarded, a
+    # wasted neighbor exchange of both K and V on the hot path
+    k_blk, v_blk, m, l, acc = jax.lax.fori_loop(
+        0, n - 1, body, (k, v, m0, l0, acc0)
+    )
+    m, l, acc = attend(n - 1, k_blk, v_blk, m, l, acc)
     # rows that attended to nothing (can't happen causally: the diagonal
     # block always contributes) would divide by zero; guard anyway
     l = jnp.maximum(l, 1e-30)

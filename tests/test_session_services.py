@@ -396,3 +396,30 @@ def test_cli_rejects_workers_for_incompatible_topology():
     ).extend(base_config())
     with pytest.raises(ValueError, match="on-policy"):
         select_trainer(bad2)
+
+
+def test_device_eval_records_video(tmp_path):
+    """Device envs render eval videos from state (the reference recorded
+    via a GL wrapper; jax envs rasterize instead): an Evaluator on
+    jax:lift with video enabled must write an episode recording."""
+    from surreal_tpu.envs import make_env
+    from surreal_tpu.launch.evaluator import Evaluator
+    from surreal_tpu.session.default_configs import BASE_ENV_CONFIG
+
+    vdir = str(tmp_path / "vids")
+    env_cfg = Config(
+        name="jax:lift",
+        num_envs=1,
+        video=Config(enabled=True, dir=vdir, every_n_episodes=1),
+    ).extend(BASE_ENV_CONFIG)
+    probe = make_env(env_cfg)
+    learner = build_learner(Config(algo=Config(name="ppo")), probe.specs)
+    state = learner.init(jax.random.key(0))
+    ev = Evaluator(env_cfg, Config(episodes=2, mode="deterministic", max_steps=20), learner)
+    try:
+        out = ev.evaluate(state, jax.random.key(1))
+        assert np.isfinite(out["eval/return"])
+        files = os.listdir(vdir)
+        assert any(f.startswith("episode_") for f in files), files
+    finally:
+        ev.close()
