@@ -191,12 +191,14 @@ def frame_renderer(env):
     from surreal_tpu.envs.jax.pong import Pong
 
     if isinstance(env, _DevicePixels):
-        render = type(env).render
+        render = jax.jit(type(env).render)  # one dispatch per frame, not per op
         return lambda s: _views_to_rgb(render(s.inner))
     if isinstance(env, BlockLift):
-        return lambda s: _views_to_rgb(render_lift(s))
+        render = jax.jit(render_lift)
+        return lambda s: _views_to_rgb(render(s))
     if isinstance(env, NutAssembly):
-        return lambda s: _views_to_rgb(render_nut(s))
+        render = jax.jit(render_nut)
+        return lambda s: _views_to_rgb(render(s))
     if isinstance(env, Pong):
         import numpy as np
 

@@ -58,6 +58,18 @@ class Evaluator:
             self._video_cfg = env_config.video
             self._video_episode = 0
             if self._video_cfg.enabled and self._video_cfg.dir:
+                from surreal_tpu.envs.jax.pixels import frame_renderer
+
+                self._render_frame = frame_renderer(self.env.env)
+                if self._render_frame is None:
+                    # fail-fast-on-unwired-knobs convention: silence here
+                    # would leave the user's video dir empty forever
+                    raise ValueError(
+                        "env_config.video.enabled is set but device env "
+                        f"{type(self.env.env).__name__} has no frame "
+                        "renderer (envs/jax/pixels.py frame_renderer) — "
+                        "disable video or add a renderer for this env"
+                    )
                 # record on the UNWRAPPED env: AutoReset replaces the
                 # terminal state with the next reset state, which would
                 # make the outcome frame (the lift, the thread)
@@ -148,13 +160,12 @@ class Evaluator:
         """Roll ONE un-vmapped episode with the current policy, rendering
         each step's state to a frame; honors video.every_n_episodes
         across evaluate() calls (the eval cadence drives the rest)."""
-        from surreal_tpu.envs.jax.pixels import frame_renderer
         from surreal_tpu.envs.video import save_episode_frames
 
-        render = frame_renderer(self.env.env)  # unwrap AutoReset
+        render = self._render_frame  # cached + jitted at __init__
         episode = self._video_episode
         self._video_episode += 1
-        if render is None or episode % max(1, self._video_cfg.every_n_episodes):
+        if episode % max(1, self._video_cfg.every_n_episodes):
             return
         key, reset_key = jax.random.split(key)
         env_state, obs = self.env.env.reset(reset_key)  # raw env, no AutoReset

@@ -60,6 +60,10 @@ class SessionHooks:
         self.writer = make_metrics_writer(cfg, name=name)
         self.ckpt: CheckpointManager | None = make_checkpoint_manager(cfg)
         self._ckpt_every = PeriodicTracker(max(1, cfg.checkpoint.every_n_iters))
+        # optional step-aligned auxiliary state (the off-policy trainer
+        # sets this to snapshot its replay buffer when
+        # checkpoint.include_replay is on); zero-arg callable -> pytree
+        self.extra_state_fn = None
 
         self.evaluator = None
         ev = cfg.eval
@@ -211,6 +215,8 @@ class SessionHooks:
                 env_steps=env_steps,
                 metrics=self.last_metrics,
             )
+            if self.extra_state_fn is not None:
+                self.ckpt.save_extra(iteration, self.extra_state_fn())
         self._profiler_tick(iteration)
         stop = m is not None and on_metrics is not None and bool(
             on_metrics(iteration, m)
@@ -227,6 +233,8 @@ class SessionHooks:
                 env_steps=env_steps,
                 metrics={**self._last_train, **self._last_eval},
             )
+            if self.extra_state_fn is not None:
+                self.ckpt.save_extra(iteration, self.extra_state_fn())
 
     def _profiler_tick(self, iteration: int) -> None:
         if not self._prof_enabled:

@@ -347,6 +347,14 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
             config.env_config.num_envs, self.nprocs,
             "num_envs", "the process count",
         )
+        if config.session_config.checkpoint.get("include_replay", False):
+            raise ValueError(
+                "checkpoint.include_replay is single-host only: the "
+                "multi-host replay is sharded across every host's devices "
+                "and rank-0 orbax cannot address the other hosts' shards "
+                "— resume refills the buffer instead (the reference's own "
+                "semantics, SURVEY.md §5.4)"
+            )
         # OffPolicyTrainer.__init__ builds the GLOBAL mesh (jax.devices()
         # spans hosts once jax.distributed is up), the per-device-scaled
         # replay, and the dp_offpolicy_iter shard_map — unchanged.

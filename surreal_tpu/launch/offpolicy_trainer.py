@@ -334,6 +334,21 @@ class OffPolicyTrainer:
                 replay_state = sharded_replay_init(self.replay, example, self.mesh)
             else:
                 replay_state = self.replay.init(example)
+            if (
+                cfg.checkpoint.get("include_replay", False)
+                and hooks.ckpt is not None
+            ):
+                # snapshot the buffer at every checkpoint (closure reads
+                # the loop's CURRENT replay_state) and, on resume, reload
+                # the snapshot aligned to the restored step so learning
+                # continues without a warmup refill
+                hooks.extra_state_fn = lambda: {"replay": replay_state}
+                if iteration > 0:
+                    restored = hooks.ckpt.restore_extra(
+                        {"replay": replay_state}, step=iteration
+                    )
+                    if restored is not None:
+                        replay_state = restored["replay"]
             first_call = True
             while env_steps < total:
                 key, it_key, hk_key = jax.random.split(key, 3)
@@ -374,6 +389,16 @@ class OffPolicyTrainer:
         key = jax.random.key(self.seed + 1)
         obs = self.env.reset(seed=self.config.env_config.seed)
         replay_state = self.replay.init(self._replay_example())
+        ckpt_cfg = self.config.session_config.checkpoint
+        if ckpt_cfg.get("include_replay", False) and hooks.ckpt is not None:
+            # same replay-snapshot contract as the device path
+            hooks.extra_state_fn = lambda: {"replay": replay_state}
+            if iteration > 0:
+                restored = hooks.ckpt.restore_extra(
+                    {"replay": replay_state}, step=iteration
+                )
+                if restored is not None:
+                    replay_state = restored["replay"]
         noise = np.zeros((self.num_envs, act_dim), np.float32)
         explo = self.algo.exploration
         n = self.algo.n_step

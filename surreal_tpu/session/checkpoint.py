@@ -71,6 +71,26 @@ class CheckpointManager:
         self._best_ckptr = ocp.StandardCheckpointer(
             multiprocessing_options=mp_options
         )
+        self._mp_options = mp_options
+        self._keep_last = keep_last
+        self._extra_mgr: ocp.CheckpointManager | None = None
+
+    def _extra(self) -> ocp.CheckpointManager:
+        """Lazy manager for auxiliary step-aligned state (the replay
+        buffer) — a SEPARATE tree under ``extra/`` so the main payload's
+        shape stays stable across configs and old sessions restore fine."""
+        if self._extra_mgr is None:
+            root = os.path.join(self.directory, "extra")
+            os.makedirs(root, exist_ok=True)
+            self._extra_mgr = ocp.CheckpointManager(
+                root,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self._keep_last,
+                    create=False,
+                    multiprocessing_options=self._mp_options,
+                ),
+            )
+        return self._extra_mgr
 
     # -- save ----------------------------------------------------------------
     def save(
@@ -107,6 +127,21 @@ class CheckpointManager:
         with open(tmp, "w") as f:
             json.dump({"value": float(value), "step": int(step)}, f)
         os.replace(tmp, self._best_meta_path)
+
+    def save_extra(self, step: int, tree: Any) -> None:
+        """Persist auxiliary state aligned to ``step`` (see ``_extra``)."""
+        mgr = self._extra()
+        mgr.save(step, args=ocp.args.StandardSave(tree))
+        mgr.wait_until_finished()
+
+    def restore_extra(self, template: Any, step: int):
+        """Restore the auxiliary tree saved at EXACTLY ``step`` (the step
+        the main state restored from); None when absent — callers fall
+        back to a fresh buffer, same as resuming an old session."""
+        if step not in self._extra().all_steps():
+            return None
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        return self._extra().restore(step, args=ocp.args.StandardRestore(abstract))
 
     # -- restore -------------------------------------------------------------
     def latest_step(self) -> int | None:
@@ -156,6 +191,8 @@ class CheckpointManager:
     def close(self) -> None:
         self._mgr.close()
         self._best_ckptr.close()
+        if self._extra_mgr is not None:
+            self._extra_mgr.close()
 
 
 def make_checkpoint_manager(session_config) -> CheckpointManager | None:

@@ -100,6 +100,11 @@ BASE_SESSION_CONFIG = Config(
         keep_best=True,
         restore_from=None,   # foreign session folder to warm-start from
         auto_resume=True,    # resume from own folder's latest checkpoint
+        # off-policy only: also checkpoint the replay buffer so a resume
+        # skips the warmup refill (the reference did NOT checkpoint replay,
+        # SURVEY.md §5.4 — this is a beyond-parity opt-in; storage cost is
+        # the buffer itself)
+        include_replay=False,
     ),
     metrics=Config(
         every_n_iters=10,

@@ -1,6 +1,7 @@
 """DDPG learner / n-step aggregator / off-policy trainer tests
 (SURVEY.md §4; BASELINE config ③ pairs DDPG with prioritized replay)."""
 
+import os
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -277,3 +278,61 @@ def test_offpolicy_host_mode_nstep_end_to_end():
     assert np.isfinite(metrics["loss/critic"])
     assert np.isfinite(metrics["loss/actor"])
     assert metrics["time/env_steps"] >= 8 * 4 * 5
+
+
+def test_offpolicy_replay_checkpoint_resume_skips_warmup(tmp_path):
+    """checkpoint.include_replay (beyond-parity opt-in; the reference did
+    NOT checkpoint replay, SURVEY §5.4): a resumed run must reload the
+    buffer snapshot and do real SGD updates on its FIRST iteration,
+    instead of skipping updates while the replay refills."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+    from surreal_tpu.session.default_configs import base_config
+
+    def cfg(total_steps):
+        return Config(
+            learner_config=Config(
+                algo=Config(
+                    name="ddpg",
+                    horizon=8,
+                    updates_per_iter=2,
+                    exploration=Config(warmup_steps=0),
+                ),
+                replay=Config(
+                    kind="uniform",
+                    capacity=4096,
+                    # warmup needs TWO chunks (8*16=128 each): a fresh run's
+                    # first iteration must SKIP updates, a resumed-with-
+                    # replay run must not
+                    start_sample_size=200,
+                    batch_size=64,
+                ),
+            ),
+            env_config=Config(name="jax:pendulum", num_envs=16),
+            session_config=Config(
+                folder=str(tmp_path / "exp"),
+                total_env_steps=total_steps,
+                metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+                checkpoint=Config(every_n_iters=2, include_replay=True),
+                eval=Config(every_n_iters=0),
+            ),
+        ).extend(base_config())
+
+    steps_per_iter = 8 * 16
+    first_metrics: list = []
+    OffPolicyTrainer(cfg(4 * steps_per_iter)).run(
+        on_metrics=lambda it, m: first_metrics.append((it, m["q/mean_abs_td"]))
+    )
+    # sanity: the fresh run's first iteration skipped updates (warmup)
+    assert first_metrics[0][1] == 0.0
+    assert any(v != 0.0 for _, v in first_metrics)
+    extra_dir = tmp_path / "exp" / "checkpoints" / "extra"
+    assert extra_dir.is_dir() and any(d.isdigit() for d in os.listdir(extra_dir))
+
+    resumed: list = []
+    OffPolicyTrainer(cfg(6 * steps_per_iter)).run(
+        on_metrics=lambda it, m: resumed.append((it, m["q/mean_abs_td"]))
+    )
+    assert resumed, "resume ran no iterations"
+    assert resumed[0][0] > 4  # iteration counter continued
+    # the buffer came back with the checkpoint: updates ran immediately
+    assert resumed[0][1] != 0.0, resumed
