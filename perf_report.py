@@ -1,7 +1,7 @@
 """Perf report: the utilization story behind bench.py's headline number
 (VERDICT r2 item 4 — "turn one number into a utilization story").
 
-Runs three graded-workload-class benchmarks on the real chip and writes
+Runs four graded-workload-class benchmarks on the real chip and writes
 PERF.md next to the driver's BENCH artifacts:
 
 1. PPO + MLP on ``jax:lift``  (the headline: BASELINE config ③/north-star
@@ -9,6 +9,8 @@ PERF.md next to the driver's BENCH artifacts:
    top-line breakdown, plus a jax.profiler trace window.
 2. IMPALA + NatureCNN on ``jax:pong``  (BASELINE config ⑤ class).
 3. DDPG + prioritized replay on ``jax:lift``  (BASELINE config ③ class).
+4. PPO + NatureCNN from pixels on ``jax:nut_pixels``  (BASELINE config ④
+   class — envs rendered AND learned on device).
 
 MFU uses the TPU v5e public peak (197 TFLOP/s bf16). RL env-step
 workloads are not matmul-bound — tiny MLPs, env physics, scatter-heavy
@@ -186,6 +188,53 @@ def impala_pong() -> dict:
     return out
 
 
+def ppo_cnn_nut_pixels() -> dict:
+    from surreal_tpu.launch.rollout import init_device_carry
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    num_envs, horizon = 512, 32
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=horizon, epochs=2, num_minibatches=4),
+            model=Config(cnn=Config(enabled=True)),
+        ),
+        env_config=Config(name="jax:nut_pixels", num_envs=num_envs),
+        session_config=Config(
+            folder="/tmp/perf_nut_pixels",
+            metrics=Config(every_n_iters=10_000),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    key = jax.random.key(0)
+    key, init_key, env_key = jax.random.split(key, 3)
+    state = trainer.learner.init(init_key)
+    carry = init_device_carry(trainer.env, env_key, num_envs)
+    for _ in range(WARMUP):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.block_until_ready(metrics)
+    flops = _iter_flops(trainer._train_iter, state, carry, key)
+    dt, _ = _timeit(
+        lambda s, c, k: trainer._train_iter(s, c, k)[2], state, carry, key=key
+    )
+    sps = ITERS * num_envs * horizon / dt
+    out = {
+        "workload": "PPO+NatureCNN jax:nut_pixels (BASELINE ④ class, on-device rendering)",
+        "geometry": f"{num_envs} envs x {horizon} horizon, 64x64x4 uint8 pixels",
+        "env_steps_per_s": sps,
+        "iter_ms": dt / ITERS * 1e3,
+    }
+    if flops is not None:
+        out["flops_per_iter"] = flops
+        out["model_flops_per_s"] = flops * ITERS / dt
+        out["mfu"] = out["model_flops_per_s"] / PEAK_FLOPS_BF16
+    return out
+
+
 def ddpg_prioritized_lift() -> dict:
     from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
     from surreal_tpu.session.config import Config
@@ -234,7 +283,9 @@ def ddpg_prioritized_lift() -> dict:
 
 def main() -> None:
     rows = []
-    for fn in (ppo_lift_headline, impala_pong, ddpg_prioritized_lift):
+    for fn in (
+        ppo_lift_headline, impala_pong, ddpg_prioritized_lift, ppo_cnn_nut_pixels
+    ):
         r = fn()
         rows.append(r)
         print(json.dumps(r, default=float))

@@ -21,8 +21,10 @@ Per-process discipline (the multi-controller contract):
   warm-start, same rules as single-host), then broadcasts state + counters
   to all ranks via a device collective — kill ALL processes, relaunch with
   the same config, and the curve continues.
-- **Per-host env feed.** ``env_config.num_envs`` is the GLOBAL batch
-  width; each process contributes ``num_envs / process_count``:
+- **Per-host env feed.** For the fused/off-policy drivers
+  ``env_config.num_envs`` is the GLOBAL batch width; each process
+  contributes ``num_envs / process_count`` (the SEED driver keeps SEED's
+  own per-worker convention — see ``MultiHostSEEDTrainer``):
 
   * device envs (``jax:*``): the env carry is created directly as a
     global array sharded over ``dp`` (a jitted SPMD init — each process
@@ -51,6 +53,7 @@ import numpy as np
 from surreal_tpu.launch.hooks import SessionHooks, host_metrics
 from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
 from surreal_tpu.launch.rollout import host_rollout, init_device_carry
+from surreal_tpu.launch.seed_trainer import SEEDTrainer
 from surreal_tpu.launch.trainer import Trainer
 from surreal_tpu.parallel.mesh import check_dp_divisible, replicate_state
 from surreal_tpu.parallel.multihost import local_batch_to_global
@@ -431,5 +434,164 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
                 hooks, iteration, env_steps, lazy_host_state
             )
         finally:
+            if hooks is not None:
+                hooks.close()
+
+
+class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
+    """SEED topology across machines — the reference's truest scaling
+    shape mapped to TPU: EVERY host runs its own inference server + env
+    worker fleet (the per-machine agent pools), and each iteration every
+    rank contributes its local trajectory chunk to ONE global dp learn
+    (gradient psum across hosts over ICI/DCN).
+
+    Collective-schedule discipline: staleness DROPS are disallowed
+    (``max_staleness`` must stay None) — dropping is a per-rank decision,
+    and a rank skipping a learn while others enter the psum would
+    deadlock the mesh. IMPALA/V-trace absorbs the bounded staleness this
+    topology produces by construction; the staleness METRIC still flows.
+    Acting is strictly host-local: the server's policy closure runs on a
+    host-local copy of ONLY the acting leaves (params + obs normalizer,
+    refreshed after each global learn), never on the globally-sharded
+    state — a per-request collective would stall every other rank, and
+    shipping optimizer moments host-side every iteration would triple the
+    refresh bytes for nothing.
+
+    Batch-width semantics: ``env_config.num_envs`` keeps the SEED
+    convention (PER-WORKER batch width, exactly as single-host SEED —
+    NOT the global width the module docstring describes for the fused
+    drivers). Each rank's chunk is [horizon, num_envs]; the global learn
+    batch is num_envs x process_count (one chunk per rank), which must
+    divide the dp axis.
+    """
+
+    def __init__(self, config):
+        self._init_multihost("MultiHostSEEDTrainer")
+        explicit_dp = int(config.session_config.topology.mesh.dp)
+        if explicit_dp > 1:
+            raise ValueError(
+                "multi-host SEED uses the full global mesh (topology."
+                f"mesh.dp=-1); explicit dp={explicit_dp} subset meshes are "
+                "a single-host SEED feature"
+            )
+        SEEDTrainer.__init__(self, config)
+        if self.max_staleness is not None:
+            raise ValueError(
+                "max_staleness is single-host SEED only: dropping a chunk "
+                "is a per-rank decision that would desynchronize the "
+                "collective learn schedule — rely on V-trace (IMPALA) to "
+                "absorb bounded staleness in the multi-host topology"
+            )
+        from surreal_tpu.parallel.dp import dp_learn
+        from surreal_tpu.parallel.mesh import check_dp_divisible, make_mesh
+
+        self.mesh = make_mesh(config.session_config.topology)
+        check_dp_divisible(
+            config.env_config.num_envs * self.nprocs,
+            self.mesh.shape["dp"],
+            what="num_envs * process_count",
+        )
+        self._learn = dp_learn(self.learner, self.mesh)
+
+    def _worker_env_config(self, env_cfg):
+        """Per-rank seed decorrelation: worker i exists on EVERY rank, so
+        without an offset each rank's fleet would produce byte-identical
+        env streams and the global learn batch would carry duplicated
+        trajectories."""
+        return Config(
+            seed=env_cfg.seed + self.rank * max(1, self.num_workers)
+        ).extend(env_cfg)
+
+    def _refresh_act_state(self, state):
+        """Host-local acting snapshot: read ONLY params + obs_stats from
+        the replicated global state (a local read) and graft them onto the
+        device-resident base built at run start — optimizer moments never
+        cross the host boundary."""
+        params = jax.device_put(jax.tree.map(np.asarray, state.params))
+        stats = jax.device_put(jax.tree.map(np.asarray, state.obs_stats))
+        self._act_base = self._act_base._replace(params=params, obs_stats=stats)
+        return self._act_base
+
+    def run(
+        self,
+        max_env_steps: int | None = None,
+        on_metrics: Callable[[int, dict], None] | None = None,
+    ):
+        import threading
+
+        cfg = self.config.session_config
+        total = max_env_steps or cfg.total_env_steps
+        metrics_every = max(1, cfg.metrics.every_n_iters)
+        steps_per_iter = (
+            self.algo.horizon * self.config.env_config.num_envs * self.nprocs
+        )
+
+        key = jax.random.key(cfg.seed)  # identical chain on every rank
+        key, init_key, act_key = jax.random.split(key, 3)
+        state = self.learner.init(init_key)
+        hooks = None
+        plane = None
+        stop = threading.Event()
+        try:
+            hooks, state, iteration, env_steps = self._begin_session(state)
+
+            def lazy_host_state():
+                return _to_host_local(state)
+
+            # per-rank exploration streams; acting base lives on the LOCAL
+            # default device (full initial copy once, then params-only
+            # refreshes via _refresh_act_state)
+            key_holder = [jax.random.fold_in(act_key, self.rank)]
+            self._act_base = jax.device_put(lazy_host_state())
+            plane = self._start_data_plane(
+                self._make_act_fn(self._act_base, key_holder), stop,
+                # first chunk waits out EVERY rank's compiles
+                first_chunk_timeout=900.0,
+            )
+            # steady-state: the learn is COLLECTIVE, so this rank's next
+            # chunk can wait on the slowest rank's fleet
+            plane.steady_timeout = 120.0
+            server = plane.server
+            self._workers = plane.workers  # exposed for tests/fault injection
+
+            while env_steps < total:
+                chunk = plane.next_chunk()
+                versions = chunk.pop("param_version")
+                staleness = server.version - int(versions.min())
+                gbatch = local_batch_to_global(self.mesh, chunk, batch_dim=1)
+                key, lkey, hk_key = jax.random.split(key, 3)
+                state, metrics = self._learn(state, gbatch, lkey)
+                server.set_act_fn(
+                    self._make_act_fn(self._refresh_act_state(state), key_holder)
+                )
+                iteration += 1
+                env_steps += steps_per_iter
+                plane.supervise()
+                stop_flag = False
+                if hooks is not None:
+                    # learner metrics are global (psum crossed hosts);
+                    # server/episode stats are rank-0-local by design
+                    metrics = dict(
+                        metrics,
+                        **{
+                            "staleness/updates_behind": float(staleness),
+                            "workers/respawns": float(plane.respawns),
+                        },
+                        **server.queue_stats(),
+                        **(server.episode_stats() or {}),
+                    )
+                    _, stop_flag = hooks.end_iteration(
+                        iteration, env_steps, lazy_host_state, hk_key,
+                        metrics, on_metrics,
+                    )
+                if self._maybe_agree_stop(iteration, stop_flag, metrics_every):
+                    break
+            return state, self._end_session(
+                hooks, iteration, env_steps, lazy_host_state
+            )
+        finally:
+            stop.set()
+            if plane is not None:
+                plane.close()
             if hooks is not None:
                 hooks.close()

@@ -39,6 +39,54 @@ from surreal_tpu.learners import build_learner
 _FROM_CONFIG = object()  # sentinel: None is a meaningful max_staleness value
 
 
+class _DataPlane:
+    """Running SEED data plane: server + worker fleet + supervision.
+
+    ``next_chunk`` waits for experience while supervising workers on every
+    empty poll — a dead SOLE worker must be respawned while waiting, not
+    after a chunk it can no longer produce. ``respawns`` accumulates for
+    the metrics stream. The chunk timeout resets to ``steady_timeout``
+    after the first chunk (the first waits out XLA compiles — minutes on a
+    tunneled TPU; in the multi-host loop the steady wait also covers the
+    slowest rank's fleet, since the learn is collective)."""
+
+    def __init__(self, trainer, server, workers, env_cfg, stop, first_timeout):
+        self.trainer = trainer
+        self.server = server
+        self.workers = workers
+        self.env_cfg = env_cfg
+        self.stop = stop
+        self.respawns = 0
+        self._timeout = first_timeout
+        self.steady_timeout = 30.0
+
+    def supervise(self) -> None:
+        self.respawns += self.trainer._respawn_dead_workers(
+            self.workers, self.env_cfg, self.server.address, self.stop
+        )
+
+    def next_chunk(self) -> dict:
+        deadline = time.monotonic() + self._timeout
+        self._timeout = self.steady_timeout
+        while True:
+            try:
+                return self.server.chunks.get(timeout=2.0)
+            except queue.Empty:
+                self.supervise()
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        "no experience chunks arriving from workers"
+                    ) from None
+
+    def close(self) -> None:
+        self.stop.set()
+        self.server.close()
+        for w in self.workers:
+            if hasattr(w, "terminate"):  # subprocess workers
+                w.terminate()
+                w.join(timeout=5)
+
+
 class SEEDTrainer:
     def __init__(
         self,
@@ -157,6 +205,37 @@ class SEEDTrainer:
                 respawned += 1
         return respawned
 
+    def _start_data_plane(self, act_fn, stop, first_chunk_timeout: float):
+        """Spawn the inference server + worker fleet and return a
+        :class:`_DataPlane` handle — the shared lifecycle for the
+        single-host and multi-host SEED loops (supervision, chunk waits,
+        teardown live in ONE place)."""
+        from surreal_tpu.launch.hooks import training_env_config
+
+        server = InferenceServer(
+            act_fn=act_fn,
+            unroll_length=self.algo.horizon,
+            # coalesce all workers into one forward per lockstep round:
+            # with min_batch=1 a W-worker fleet degrades to ~W serves
+            # per round, and serve latency (not compute) is the bound
+            min_batch=self.num_workers,
+            max_wait_ms=5.0,
+        )
+        try:
+            env_cfg = self._worker_env_config(
+                training_env_config(self.config.env_config)
+            )
+            workers = self._spawn_workers(env_cfg, server.address, stop)
+        except BaseException:
+            # a failed spawn must not leak the ROUTER socket + serve thread
+            server.close()
+            raise
+        return _DataPlane(self, server, workers, env_cfg, stop, first_chunk_timeout)
+
+    def _worker_env_config(self, env_cfg):
+        """Hook: per-rank seed decorrelation in the multi-host subclass."""
+        return env_cfg
+
     def _make_act_fn(self, state, key_holder):
         def act_fn(obs_np):
             # pad the micro-batch to the next power of two: the server
@@ -191,11 +270,10 @@ class SEEDTrainer:
         key = jax.random.key(cfg.seed)
         key, init_key, act_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
-        from surreal_tpu.launch.hooks import SessionHooks, training_env_config
+        from surreal_tpu.launch.hooks import SessionHooks
 
         hooks = SessionHooks(self.config, self.learner)
-        server = None
-        workers: list = []
+        plane = None
         stop = threading.Event()
         try:
             state, iteration, env_steps = hooks.restore(state)
@@ -205,21 +283,18 @@ class SEEDTrainer:
                 state = replicate_state(self.mesh, state)
             hooks.begin_run(iteration, env_steps)
             key_holder = [act_key]
-            server = InferenceServer(
-                act_fn=self._make_act_fn(state, key_holder),
-                unroll_length=self.algo.horizon,
-                # coalesce all workers into one forward per lockstep round:
-                # with min_batch=1 a W-worker fleet degrades to ~W serves
-                # per round, and serve latency (not compute) is the bound
-                min_batch=self.num_workers,
-                max_wait_ms=5.0,
+            # the FIRST chunk waits out the policy's XLA compiles plus a
+            # full unroll of round trips (can be minutes on a tunneled
+            # TPU); workers keep their own 120s liveness budget per step,
+            # reset by each served reply
+            plane = self._start_data_plane(
+                self._make_act_fn(state, key_holder), stop,
+                first_chunk_timeout=600.0,
             )
-            env_cfg = training_env_config(self.config.env_config)
-            workers = self._spawn_workers(env_cfg, server.address, stop)
-            self._workers = workers  # exposed for tests/fault injection
+            server = plane.server
+            self._workers = plane.workers  # exposed for tests/fault injection
 
             dropped_stale = 0
-            respawns = 0
             discarded_steps = 0
 
             def data_plane_extras() -> dict:
@@ -229,37 +304,13 @@ class SEEDTrainer:
                 return {
                     "staleness/dropped_chunks": float(dropped_stale),
                     "staleness/steps_discarded": float(discarded_steps),
-                    "workers/respawns": float(respawns),
+                    "workers/respawns": float(plane.respawns),
                     **server.queue_stats(),
                     **(server.episode_stats() or {}),
                 }
-            # the FIRST chunk waits out the policy's XLA compiles plus a
-            # full unroll of round trips (can be minutes on a tunneled
-            # TPU); workers keep their own 120s liveness budget per step,
-            # reset by each served reply
-            chunk_timeout = 600.0
-
-            def next_chunk(deadline_s: float):
-                """Wait for a chunk, supervising workers on every empty
-                poll — a dead SOLE worker must be respawned while waiting,
-                not after a chunk it can no longer produce."""
-                nonlocal respawns
-                deadline = time.monotonic() + deadline_s
-                while True:
-                    try:
-                        return server.chunks.get(timeout=2.0)
-                    except queue.Empty:
-                        respawns += self._respawn_dead_workers(
-                            workers, env_cfg, server.address, stop
-                        )
-                        if time.monotonic() >= deadline:
-                            raise TimeoutError(
-                                "no experience chunks arriving from workers"
-                            ) from None
 
             while env_steps < total:
-                chunk = next_chunk(chunk_timeout)
-                chunk_timeout = 30.0
+                chunk = plane.next_chunk()
                 versions = chunk.pop("param_version")
                 staleness = server.version - int(versions.min())
                 # Accounting contract: trainer-side stale DROPS count into
@@ -279,9 +330,7 @@ class SEEDTrainer:
                     n_dropped = chunk["reward"].shape[0] * chunk["reward"].shape[1]
                     env_steps += n_dropped
                     discarded_steps += n_dropped
-                    respawns += self._respawn_dead_workers(
-                        workers, env_cfg, server.address, stop
-                    )
+                    plane.supervise()
                     continue
                 if self.mesh is not None:
                     # split host->devices directly along the dp-sharded
@@ -299,9 +348,7 @@ class SEEDTrainer:
                 server.set_act_fn(self._make_act_fn(state, key_holder))
                 iteration += 1
                 env_steps += chunk["reward"].shape[0] * chunk["reward"].shape[1]
-                respawns += self._respawn_dead_workers(
-                    workers, env_cfg, server.address, stop
-                )
+                plane.supervise()
                 metrics = dict(
                     metrics,
                     **{"staleness/updates_behind": float(staleness)},
@@ -322,10 +369,6 @@ class SEEDTrainer:
             return state, hooks.last_metrics
         finally:
             stop.set()
-            if server is not None:
-                server.close()
-            for w in workers:
-                if hasattr(w, "terminate"):  # subprocess workers
-                    w.terminate()
-                    w.join(timeout=5)
+            if plane is not None:
+                plane.close()
             hooks.close()

@@ -74,6 +74,25 @@ def _apply_backend(backend: str) -> None:
         raise ValueError(f"session_config.backend {backend!r} not in tpu|cpu")
 
 
+def _validate_seed_topology(config) -> int:
+    """The SEED inference-server topology needs a HOST env and an
+    on-policy algo — one rule for the single- AND multi-host gates (fail
+    loudly rather than silently running a different topology than the one
+    the user configured). Returns num_env_workers."""
+    algo = config.learner_config.algo.name
+    env_name = config.env_config.name
+    workers = config.session_config.topology.num_env_workers
+    if workers > 0 and (algo == "ddpg" or env_name.startswith("jax:")):
+        raise ValueError(
+            f"topology.num_env_workers={workers} selects the SEED "
+            "inference-server topology, which needs a HOST env (gym:/"
+            "dm_control:/robosuite:) and an on-policy algo (ppo, impala); "
+            f"got algo={algo!r}, env={env_name!r} — drop --workers, or "
+            "use a host env / on-policy algo"
+        )
+    return workers
+
+
 def select_trainer(config):
     """Map config -> driver (the component-dispatch role of the reference's
     launcher, collapsed to one decision):
@@ -84,18 +103,7 @@ def select_trainer(config):
     - everything else -> Trainer (fused device loop, or host alternation)
     """
     algo = config.learner_config.algo.name
-    env_name = config.env_config.name
-    workers = config.session_config.topology.num_env_workers
-    if workers > 0 and (algo == "ddpg" or env_name.startswith("jax:")):
-        # fail loudly rather than silently running a different topology
-        # than the one the user configured
-        raise ValueError(
-            f"topology.num_env_workers={workers} selects the SEED "
-            "inference-server topology, which needs a HOST env (gym:/"
-            "dm_control:/robosuite:) and an on-policy algo (ppo, impala); "
-            f"got algo={algo!r}, env={env_name!r} — drop --workers, or "
-            "use a host env / on-policy algo"
-        )
+    workers = _validate_seed_topology(config)
     if algo == "ddpg":
         from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
 
@@ -120,18 +128,13 @@ def run_train(args) -> int:
     if multihost:
         algo = config.learner_config.algo.name
         env_name = config.env_config.name
-        workers = config.session_config.topology.num_env_workers
-        if workers > 0 or (algo == "ddpg" and not env_name.startswith("jax:")):
-            # fail loudly: the SEED (inference-server) driver and host-env
-            # off-policy (replay on one host's devices) are
-            # single-controller; multi-host covers the on-policy families
-            # and device-env off-policy
+        _validate_seed_topology(config)  # one rule with select_trainer
+        if algo == "ddpg" and not env_name.startswith("jax:"):
+            # fail loudly: host-env off-policy keeps its replay on one
+            # host's devices — single-controller by design
             raise ValueError(
-                "multi-host training supports ppo/impala (device or host "
-                "envs) and ddpg on device (jax:*) envs, without --workers; "
-                f"got algo={algo!r}, env={env_name!r}, num_env_workers="
-                f"{workers} — run that combination single-host, or scale "
-                "it by mesh axes within one host"
+                "multi-host ddpg needs a device env (jax:*); host-env "
+                f"off-policy runs single-host (got env={env_name!r})"
             )
     import jax
 
@@ -145,7 +148,11 @@ def run_train(args) -> int:
         ) as f:
             f.write(config.dumps())
     if multihost:
-        if config.learner_config.algo.name == "ddpg":
+        if config.session_config.topology.num_env_workers > 0:
+            from surreal_tpu.launch.multihost_trainer import MultiHostSEEDTrainer
+
+            trainer = MultiHostSEEDTrainer(config)
+        elif config.learner_config.algo.name == "ddpg":
             from surreal_tpu.launch.multihost_trainer import (
                 MultiHostOffPolicyTrainer,
             )

@@ -341,3 +341,71 @@ def test_cli_multihost_offpolicy_prioritized(tmp_path):
     # rank-0-only discipline holds for the off-policy driver too
     assert not folder1.exists()
     assert not [ln for ln in outs[1].splitlines() if ln.startswith("{")]
+
+
+@pytest.mark.slow
+def test_cli_multihost_seed_impala(tmp_path):
+    """SEED across machines through the real CLI: two OS processes, each
+    running its OWN inference server + env-worker fleet (the reference's
+    per-machine agent pools), contributing local trajectory chunks to one
+    global IMPALA learn over the 8-device mesh."""
+    folder0 = tmp_path / "session"
+    folder1 = tmp_path / "rank1_should_stay_empty"
+    # 2 ranks x 4 envs x 8 horizon = 64 steps per global iteration
+    # (global batch 8 = the 8-device dp axis; num_envs*nprocs must divide dp)
+    total = 64 * 5
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + repo
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(i)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "surreal_tpu", "train", "impala",
+                    "gym:CartPole-v1", "--folder",
+                    str([folder0, folder1][i]),
+                    "--num-envs", "4", "--workers", "2",
+                    "--total-steps", str(total),
+                    "--set",
+                    "session_config.backend=cpu",
+                    "learner_config.algo.horizon=8",
+                    "session_config.checkpoint.every_n_iters=0",
+                    "session_config.metrics.every_n_iters=1",
+                    "session_config.metrics.tensorboard=false",
+                    "session_config.metrics.console=false",
+                    "session_config.eval.every_n_iters=0",
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+                cwd=repo,
+            )
+        )
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for out, p in zip(outs, procs):
+        assert p.returncode == 0, out[-3000:]
+
+    import json
+
+    import numpy as np
+
+    metrics_line = [ln for ln in outs[0].splitlines() if ln.startswith("{")][-1]
+    metrics = json.loads(metrics_line)
+    assert metrics["time/env_steps"] >= total
+    assert np.isfinite(metrics["loss/pg"])
+    assert metrics["staleness/updates_behind"] >= 0.0
+    assert not folder1.exists()
+    assert not [ln for ln in outs[1].splitlines() if ln.startswith("{")]
