@@ -23,21 +23,17 @@ def build_learner(learner_config, env_specs: EnvSpecs) -> Learner:
         cfg = learner_config.extend(PPO_LEARNER_CONFIG.extend(_base()))
         return PPOLearner(cfg, env_specs)
     if name == "ddpg":
-        try:
-            from surreal_tpu.learners.ddpg import DDPG_LEARNER_CONFIG, DDPGLearner
-        except ImportError as e:
-            raise NotImplementedError("ddpg learner module not present yet") from e
+        # unconditional import: a broken module must surface, not be
+        # rebranded "not present yet" (round-1 scaffolding guard removed)
+        from surreal_tpu.learners.ddpg import DDPG_LEARNER_CONFIG, DDPGLearner
 
         cfg = learner_config.extend(DDPG_LEARNER_CONFIG.extend(_base()))
         return DDPGLearner(cfg, env_specs)
     if name == "impala":
-        try:
-            from surreal_tpu.learners.impala import (
-                IMPALA_LEARNER_CONFIG,
-                IMPALALearner,
-            )
-        except ImportError as e:
-            raise NotImplementedError("impala learner module not present yet") from e
+        from surreal_tpu.learners.impala import (
+            IMPALA_LEARNER_CONFIG,
+            IMPALALearner,
+        )
 
         cfg = learner_config.extend(IMPALA_LEARNER_CONFIG.extend(_base()))
         return IMPALALearner(cfg, env_specs)
