@@ -73,7 +73,9 @@ def full_attention(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+def ring_attention(
+    q, k, v, axis_name: str, causal: bool = False, remat: bool = True
+):
     """Blockwise ring attention; call INSIDE ``shard_map`` with the time
     axis sharded over ``axis_name``.
 
@@ -85,6 +87,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     ``axis_size`` steps every device has attended to every block. Causal
     masking uses global block offsets, so cross-block masks are all-or-
     nothing except the diagonal block's triangle.
+
+    ``remat`` (default on) wraps each block update in ``jax.checkpoint``:
+    the backward pass recomputes the [Tq, Tk] probability blocks instead
+    of saving n of them, eliminating the quadratic
+    O(T_local * T_global) residual — the flash-attention memory story
+    (FLOPs traded for HBM). The linear O(T_global * H * D) term (each
+    block's K/V/stat inputs) is still saved by the scan; size HBM for
+    that, not for zero.
     """
     B, T, H, D = q.shape
     n = jax.lax.axis_size(axis_name)
@@ -108,7 +118,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
             mask = jnp.where(src == my, tri, jnp.broadcast_to(src < my, (T, T)))
         else:
             mask = jnp.ones((T, T), bool)
-        return _block_attend(q, k_blk, v_blk, mask, m, l, acc, scale)
+        # prevent_cse=False: the CSE-guard barriers are unnecessary (and
+        # cost) when differentiating under lax.scan, per jax's own docs
+        block = (
+            jax.checkpoint(_block_attend, prevent_cse=False)
+            if remat
+            else _block_attend
+        )
+        return block(q, k_blk, v_blk, mask, m, l, acc, scale)
 
     def body(i, carry):
         k_blk, v_blk, m, l, acc = carry
@@ -132,15 +149,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
 
 
 @functools.lru_cache(maxsize=32)
-def _ring_jit(mesh, axis: str, causal: bool):
-    """One compiled ring program per (mesh, axis, causal) — rebuilding the
-    shard_map/jit per call would miss the jit cache and recompile every
-    eager invocation (Mesh is hashable, so it keys the cache directly)."""
+def _ring_jit(mesh, axis: str, causal: bool, remat: bool):
+    """One compiled ring program per (mesh, axis, causal, remat) —
+    rebuilding the shard_map/jit per call would miss the jit cache and
+    recompile every eager invocation (Mesh is hashable, so it keys the
+    cache directly)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
     attend = shard_map(
-        functools.partial(ring_attention, axis_name=axis, causal=causal),
+        functools.partial(
+            ring_attention, axis_name=axis, causal=causal, remat=remat
+        ),
         mesh=mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis),
@@ -150,9 +170,11 @@ def _ring_jit(mesh, axis: str, causal: bool):
     return jax.jit(attend)
 
 
-def ring_self_attention(mesh, q, k, v, causal: bool = False, axis: str = "sp"):
+def ring_self_attention(
+    mesh, q, k, v, causal: bool = False, axis: str = "sp", remat: bool = True
+):
     """Host-side convenience: run :func:`ring_attention` under
     ``shard_map`` with the time axis of [B, T, H, D] inputs sharded over
     ``mesh[axis]`` (batch/heads replicated — shard those over dp/tp
     outside if needed)."""
-    return _ring_jit(mesh, axis, causal)(q, k, v)
+    return _ring_jit(mesh, axis, causal, remat)(q, k, v)
