@@ -121,13 +121,14 @@ def test_two_process_global_mesh_dp_learn_stays_in_sync(tmp_path):
 
 def _spawn_cli_pair(
     port, folders, total_steps, env_name="jax:pendulum", algo="ppo",
-    extra_set=(),
+    extra_set=(), workers=0, num_envs=8,
 ):
     """Two CLI processes, 4 sim devices each, forming one 8-device mesh via
     the env-var fallback path (JAX_COORDINATOR_ADDRESS / _NUM_PROCESSES /
     _PROCESS_ID — the GKE/xmanager launcher contract)."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     algo_set = {
+        "impala": [],
         "ppo": [
             "learner_config.algo.epochs=1",
             "learner_config.algo.num_minibatches=1",
@@ -153,7 +154,9 @@ def _spawn_cli_pair(
                 [
                     sys.executable, "-m", "surreal_tpu", "train", algo,
                     env_name, "--folder", str(folders[i]),
-                    "--num-envs", "8", "--total-steps", str(total_steps),
+                    "--num-envs", str(num_envs),
+                    *(["--workers", str(workers)] if workers else []),
+                    "--total-steps", str(total_steps),
                     "--set",
                     "session_config.backend=cpu",
                     "learner_config.algo.horizon=8",
@@ -175,30 +178,38 @@ def _spawn_cli_pair(
     return procs
 
 
-@pytest.mark.slow
-def test_cli_multihost_train_kill_and_resume(tmp_path):
-    """The full multi-host story through the real CLI: two OS processes
-    train as one 8-device program with rank-0-only session services; both
-    are SIGKILLed mid-run; a relaunch with the same config auto-resumes and
-    completes — the curve continues across the kill (VERDICT r2 missing #1).
 
-    Rank 1 is pointed at a folder that must NEVER be created: ranks > 0
-    run no session services and need no shared filesystem (state reaches
-    them by broadcast, not by reading rank 0's checkpoint)."""
+
+def _kill_tree(pid: int) -> None:
+    """SIGKILL a process AND its children (spawn-mode env workers are
+    daemon children whose atexit cleanup a bare SIGKILL of the parent
+    skips — orphans would keep polling for up to their 120s liveness
+    budget and load the box under the next phase)."""
     import signal
+
+    try:
+        with open(f"/proc/{pid}/task/{pid}/children") as f:
+            kids = [int(c) for c in f.read().split()]
+    except OSError:
+        kids = []
+    for kid in kids:
+        _kill_tree(kid)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def _watch_then_kill(procs, ckpt_dir, timeout_s: float):
+    """Phase-1 harness for kill-and-resume tests: wait until a checkpoint
+    step dir lands (or a process dies early = real failure), then SIGKILL
+    every rank and its worker children. Returns the last complete step."""
     import time
 
-    folder0 = tmp_path / "session"
-    folder1 = tmp_path / "rank1_should_stay_empty"
-    ckpt_dir = folder0 / "checkpoints"
-
-    # phase 1: effectively-unbounded budget; kill both once a checkpoint
-    # step has landed on disk
-    procs = _spawn_cli_pair(_free_port(), [folder0, folder1], 10**9)
+    deadline = time.time() + timeout_s
+    step_dirs: list = []
+    dead = None
     try:
-        deadline = time.time() + 180
-        step_dirs = []
-        dead = None
         while time.time() < deadline:
             dead = next((p for p in procs if p.poll() is not None), None)
             if dead is not None:
@@ -213,20 +224,40 @@ def test_cli_multihost_train_kill_and_resume(tmp_path):
     finally:
         for p in procs:
             if p.poll() is None:
-                p.send_signal(signal.SIGKILL)
-        outs1 = [p.communicate()[0] for p in procs]
-    if dead is not None:  # early death = real failure, not a kill of ours
+                _kill_tree(p.pid)
+        outs = [p.communicate()[0] for p in procs]
+    if dead is not None:
         raise AssertionError(
             f"phase-1 process died rc={dead.returncode}:\n"
-            + "\n---\n".join(o[-2000:] for o in outs1)
+            + "\n---\n".join(o[-2000:] for o in outs)
         )
-    assert step_dirs, "no checkpoint appeared within 180s"
+    assert step_dirs, f"no checkpoint appeared within {timeout_s:.0f}s"
+    return max(int(d) for d in os.listdir(ckpt_dir) if d.isdigit())
 
-    # iterations are fast once compiled, so arbitrarily many checkpoints may
-    # have landed between our poll and the SIGKILL — size the phase-2 budget
-    # off the last COMPLETE step on disk (orbax renames tmp dirs only on
-    # completion, so digit-named dirs are always restorable)
-    killed_at = max(int(d) for d in os.listdir(ckpt_dir) if d.isdigit())
+
+@pytest.mark.slow
+def test_cli_multihost_train_kill_and_resume(tmp_path):
+    """The full multi-host story through the real CLI: two OS processes
+    train as one 8-device program with rank-0-only session services; both
+    are SIGKILLed mid-run; a relaunch with the same config auto-resumes and
+    completes — the curve continues across the kill (VERDICT r2 missing #1).
+
+    Rank 1 is pointed at a folder that must NEVER be created: ranks > 0
+    run no session services and need no shared filesystem (state reaches
+    them by broadcast, not by reading rank 0's checkpoint)."""
+    folder0 = tmp_path / "session"
+    folder1 = tmp_path / "rank1_should_stay_empty"
+    ckpt_dir = folder0 / "checkpoints"
+
+    # phase 1: effectively-unbounded budget; kill both once a checkpoint
+    # step has landed on disk. Iterations are fast once compiled, so
+    # arbitrarily many checkpoints may land between the poll and the kill
+    # — the phase-2 budget sizes off the last COMPLETE step on disk
+    # (orbax renames tmp dirs only on completion).
+    killed_at = _watch_then_kill(
+        _spawn_cli_pair(_free_port(), [folder0, folder1], 10**9),
+        ckpt_dir, timeout_s=180,
+    )
     assert killed_at >= 2
     steps_per_iter = 64  # 8 envs x 8 horizon (the spawn args above)
     extra_iters = 4
@@ -407,5 +438,58 @@ def test_cli_multihost_seed_impala(tmp_path):
     assert metrics["time/env_steps"] >= total
     assert np.isfinite(metrics["loss/pg"])
     assert metrics["staleness/updates_behind"] >= 0.0
+    assert not folder1.exists()
+    assert not [ln for ln in outs[1].splitlines() if ln.startswith("{")]
+
+
+@pytest.mark.slow
+def test_cli_multihost_seed_kill_and_resume(tmp_path):
+    """SEED-across-machines recovery contract: SIGKILL both ranks (and
+    their spawned worker children) mid-run, relaunch with the same config
+    — rank 0 restores, broadcasts, and the curve continues past the kill
+    point (auto-resume visible in the train log; final checkpoint lands
+    at the full budget; rank-1 discipline holds)."""
+    import json
+
+    folder0 = tmp_path / "session"
+    folder1 = tmp_path / "rank1_should_stay_empty"
+    ckpt_dir = folder0 / "checkpoints"
+    steps_per_iter = 8 * 4 * 2  # horizon x num_envs x ranks
+
+    def spawn(total):
+        return _spawn_cli_pair(
+            _free_port(), [folder0, folder1], total,
+            env_name="gym:CartPole-v1", algo="impala", workers=2, num_envs=4,
+        )
+
+    killed_at = _watch_then_kill(spawn(10**9), ckpt_dir, timeout_s=240)
+
+    # phase 2: finite budget past the kill point -> auto-resume completes
+    total = (killed_at + 3) * steps_per_iter
+    procs = spawn(total)
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                _kill_tree(p.pid)
+                p.communicate()
+    for out, p in zip(outs, procs):
+        assert p.returncode == 0, out[-3000:]
+    metrics_line = [ln for ln in outs[0].splitlines() if ln.startswith("{")][-1]
+    metrics = json.loads(metrics_line)
+    assert metrics["time/env_steps"] >= total
+    # the curve CONTINUED: resume is recorded, and the final checkpoint
+    # sits at the full budget (a cold restart could not reach it in 3
+    # iterations)
+    logs_dir = folder0 / "logs"
+    log_text = "".join(
+        (logs_dir / f).read_text()
+        for f in os.listdir(logs_dir) if f.endswith(".log")
+    )
+    assert "auto-resumed" in log_text, log_text[-2000:]
+    final_steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    assert max(final_steps) >= killed_at + 3, (final_steps, killed_at)
+    # rank-0-only discipline
     assert not folder1.exists()
     assert not [ln for ln in outs[1].splitlines() if ln.startswith("{")]
