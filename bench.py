@@ -5,12 +5,26 @@ BlockLifting-class workload (the graded metric: BASELINE.json defines
 surreal_tpu/envs/jax/lift.py for the robosuite/MJX-availability note).
 
 Workload: PPO with a large vmapped env batch — rollout + GAE + minibatched
-SGD all in one compiled program per iteration, dispatched asynchronously so
-tunnel/dispatch latency overlaps device compute (the steps counted are real
+SGD all in one compiled program per iteration. The steps counted are real
 policy-driven env steps inside the training loop, not a bare env-step
-microbenchmark).
+microbenchmark.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+MEASUREMENT INTEGRITY (round-3 correction): on this image's tunneled
+backend, ``jax.block_until_ready`` RETURNS WITHOUT WAITING for program
+completion, which silently inflated earlier recorded numbers (BENCH_r01/
+r02 and round-2 README claims in the billions) by ~1000x. The only
+trustworthy fence is ``jax.device_get`` of a program OUTPUT — verified by
+linearity in iteration count and by FLOP sanity (the old numbers implied
+>100% MXU utilization on CNN workloads, a physical impossibility). This
+bench times a CHAINED loop (each iteration consumes the previous state)
+fenced by ``device_get``. Honest throughput on one v5lite chip is
+~3M env steps/s — ~30x the 100k north-star, not the fantasy 29,000x.
+
+The workload is latency-bound on the env scan (hundreds of sequential
+tiny elementwise ops per step), not matmul-bound: MFU is reported for
+transparency and is expectedly tiny; steps/s is the graded metric.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 vs_baseline is value / 100_000 — the north-star ">=100k env steps/sec/chip"
 from BASELINE.json (the reference itself published no numbers; SURVEY.md §6).
 """
@@ -23,22 +37,20 @@ import time
 
 import jax
 
-# Throughput-optimal batch geometry, measured on one v5lite chip (sweep in
-# round 2): steps/s scales ~linearly with envs*horizon up to >=16k envs
-# (the small-config ceiling is dispatch latency, not compute); 4096x256 is
-# the knee where per-iter dispatch overhead is fully amortized while the
-# program is still a config a user would actually train (PPO learns lift
-# with these shapes — see tests/test_envs.py::test_ppo_learns_on_lift and
-# the 1024x128 time-to-reward config in README.md).
-NUM_ENVS = 4096
-HORIZON = 256
+# Throughput-optimal batch geometry from the round-3 HONEST sweep
+# (device_get-fenced, one v5lite chip): 512x128 1.68M, 1024x128 2.85M,
+# 2048x128 3.16M (knee), 4096x128 2.98M, 8192x128 2.55M steps/s.
+# Width beyond ~2048 costs linearly (elementwise env ops saturate), and
+# horizon costs linearly always (sequential scan), so the knee is the
+# widest batch that still amortizes per-iteration overhead.
+NUM_ENVS = 2048
+HORIZON = 128
 WARMUP_ITERS = 2
 MEASURE_ITERS = 10
 NORTH_STAR = 100_000.0
 # TPU v5e (v5lite) public peak: 197 TFLOP/s bf16 per chip — the MFU
-# denominator. RL env-step workloads are NOT matmul-bound (tiny MLPs, env
-# physics, data movement), so MFU here is an honesty metric, not a target:
-# it says what fraction of the chip the headline steps/s actually uses.
+# denominator. This workload is latency-bound on the env scan, so MFU is
+# an honesty metric (expectedly tiny), not a target.
 PEAK_FLOPS_BF16 = 197e12
 
 
@@ -81,18 +93,27 @@ def main() -> None:
 
     carry = init_device_carry(trainer.env, env_key, NUM_ENVS)
 
-    # warmup (compile) -- not measured
+    # warmup (compile) -- not measured. device_get, NOT block_until_ready:
+    # the latter returns without waiting on this backend (see module doc)
     for _ in range(WARMUP_ITERS):
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
-    jax.block_until_ready(metrics)
+    jax.device_get(metrics)
     flops_per_iter = _iter_flops(trainer._train_iter, state, carry, key)
+
+    # throwaway timed window: the first timed window of a freshly
+    # compiled program can carry a ~10x one-time tunnel artifact even
+    # after the compile warmup above
+    for _ in range(2):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.device_get(metrics)
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_ITERS):
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
-    jax.block_until_ready(metrics)
+    jax.device_get(metrics)  # the only trustworthy completion fence
     dt = time.perf_counter() - t0
 
     steps = MEASURE_ITERS * NUM_ENVS * HORIZON

@@ -12,10 +12,15 @@ PERF.md next to the driver's BENCH artifacts:
 4. PPO + NatureCNN from pixels on ``jax:nut_pixels``  (BASELINE config ④
    class — envs rendered AND learned on device).
 
-MFU uses the TPU v5e public peak (197 TFLOP/s bf16). RL env-step
-workloads are not matmul-bound — tiny MLPs, env physics, scatter-heavy
-replay — so single-digit MFU is expected and honest; the headline metric
-remains env steps/s/chip (BASELINE.json), MFU says what the chip had left.
+MFU uses the TPU v5e public peak (197 TFLOP/s bf16). These workloads are
+LATENCY-BOUND on long scans of tiny elementwise env ops, not matmul-bound
+— MFU is expectedly tiny and reported for transparency; the headline
+metric remains env steps/s/chip (BASELINE.json).
+
+Round-3 measurement correction: all timing is fenced by jax.device_get —
+jax.block_until_ready returns WITHOUT waiting on this image's tunneled
+backend, which inflated earlier recorded numbers ~1000x (see bench.py's
+module doc for the forensics).
 
 Usage:  python perf_report.py            # writes PERF.md
 """
@@ -35,19 +40,28 @@ ITERS = 10  # match bench.py's window; short windows over the tunneled
             # chip showed ~1.6x run-to-run spread on sub-ms iterations
 
 
-def _timeit(fn, *args, iters=ITERS, split_key=True, key=None):
-    """Time ``iters`` calls of a compiled fn; returns (seconds, last_out)."""
-    out = None
-    t0 = time.perf_counter()
+def _timeit_chained(step, carry0, key, iters=ITERS):
+    """Time ``iters`` CHAINED calls: each call consumes the previous
+    call's outputs, so launches cannot overlap on the device.
+
+    MEASUREMENT INTEGRITY: the completion fence is ``jax.device_get`` of
+    the final observable — on this image's tunneled backend
+    ``jax.block_until_ready`` RETURNS WITHOUT WAITING, which inflated
+    earlier recorded numbers ~1000x (caught as >100% MFU, a physical
+    impossibility; verified honest by linearity in ``iters``). Chaining
+    alone is NOT sufficient; only pulling real result bytes is.
+
+    ``step(carry, key) -> (carry, observable)``; returns (seconds, carry).
+    """
     k = key
+    carry = carry0
+    obs = None
+    t0 = time.perf_counter()
     for _ in range(iters):
-        if split_key and k is not None:
-            k, sub = jax.random.split(k)
-            out = fn(*args, sub)
-        else:
-            out = fn(*args)
-    jax.block_until_ready(out)
-    return time.perf_counter() - t0, out
+        k, sub = jax.random.split(k)
+        carry, obs = step(carry, sub)
+    jax.device_get(obs)  # the only trustworthy fence on this backend
+    return time.perf_counter() - t0, carry
 
 
 def ppo_lift_headline() -> dict:
@@ -81,11 +95,17 @@ def ppo_lift_headline() -> dict:
     jax.block_until_ready(metrics)
     flops = _iter_flops(trainer._train_iter, state, carry, key)
 
-    dt, _ = _timeit(
-        lambda s, c, k: trainer._train_iter(s, c, k)[2], state, carry, key=key
-    )
-    # keep state/carry from the timing loop out of the breakdown: re-run
-    # the pieces on the same shapes
+    def fused_step(sc, k):
+        s, c = sc
+        s, c, m = trainer._train_iter(s, c, k)
+        return (s, c), m
+
+    # throwaway window: the first timed program after process start has
+    # shown a ~10x one-time tunnel warmup artifact (observed: 3967 ms/iter
+    # first window vs 400 ms/iter for the identical geometry later in the
+    # same process); record the steady window
+    _, (state, carry) = _timeit_chained(fused_step, (state, carry), key)
+    dt, (state, carry) = _timeit_chained(fused_step, (state, carry), key)
     sps = ITERS * num_envs * horizon / dt
 
     # top-line breakdown: rollout-only vs learn-only compiled separately
@@ -98,7 +118,16 @@ def ppo_lift_headline() -> dict:
     key, rk = jax.random.split(key)
     carry2, batch = roll(state, carry, rk)
     jax.block_until_ready(batch)
-    dt_roll, _ = _timeit(lambda s, c, k: roll(s, c, k)[1], state, carry, key=key)
+
+    def roll_step(c, k):
+        c2, b = roll(state, c, k)
+        # small observable: fencing on the full [T, B, ...] batch would
+        # pull ~0.5 GB through the tunnel and bill the transfer (~1.5 s)
+        # to the rollout — observed before this slice was added
+        return c2, b["reward"][-1]
+
+    _, carry_w = _timeit_chained(roll_step, carry, key)  # throwaway window
+    dt_roll, _ = _timeit_chained(roll_step, carry_w, key)
 
     learn_batch = {
         k: batch[k]
@@ -109,22 +138,18 @@ def ppo_lift_headline() -> dict:
     key, lk = jax.random.split(key)
     s2, m2 = learn(state, learn_batch, lk)
     jax.block_until_ready(m2)
-    dt_learn, _ = _timeit(
-        lambda s, b, k: learn(s, b, k)[1], state, learn_batch, key=key
-    )
 
-    # profiler window over two fused iters (SURVEY.md §5.1)
-    trace_dir = "/tmp/perf_lift/profile"
-    try:
-        with jax.profiler.trace(trace_dir):
-            for _ in range(2):
-                key, it_key = jax.random.split(key)
-                state, carry, metrics = trainer._train_iter(state, carry, it_key)
-            jax.block_until_ready(metrics)
-        traced = True
-    except Exception:
-        traced = False
+    def learn_step(s, k):
+        s2, m = learn(s, learn_batch, k)
+        return s2, m
 
+    _, state_w = _timeit_chained(learn_step, state, key)  # throwaway window
+    dt_learn, _ = _timeit_chained(learn_step, state_w, key)
+
+    # NOTE: no jax.profiler.trace here — on the axon backend a trace
+    # window poisons every program compiled AFTER it (observed 500-1000x
+    # slowdowns on post-trace compilations); the report's trace runs LAST
+    # in main(), after all measurements.
     out = {
         "workload": "PPO+MLP jax:lift (BASELINE ③/north-star class)",
         "geometry": f"{num_envs} envs x {horizon} horizon, 4 epochs x 4 minibatches",
@@ -132,7 +157,7 @@ def ppo_lift_headline() -> dict:
         "iter_ms": dt / ITERS * 1e3,
         "rollout_only_ms": dt_roll / ITERS * 1e3,
         "learn_only_ms": dt_learn / ITERS * 1e3,
-        "trace_dir": trace_dir if traced else None,
+        "_trace_fn": lambda: _capture_trace(trainer, state, carry, key),
     }
     if flops is not None:
         out["flops_per_iter"] = flops
@@ -169,11 +194,18 @@ def impala_pong() -> dict:
     for _ in range(WARMUP):
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
-    jax.block_until_ready(metrics)
+    jax.device_get(metrics)
     flops = _iter_flops(trainer._train_iter, state, carry, key)
-    dt, _ = _timeit(
-        lambda s, c, k: trainer._train_iter(s, c, k)[2], state, carry, key=key
-    )
+
+    def fused_step(sc, k):
+        s, c = sc
+        s, c, m = trainer._train_iter(s, c, k)
+        return (s, c), m
+
+    # throwaway window first: freshly compiled programs show a one-time
+    # multi-second tunnel artifact on their first timed window
+    _, sc_w = _timeit_chained(fused_step, (state, carry), key, iters=2)
+    dt, _ = _timeit_chained(fused_step, sc_w, key)
     sps = ITERS * num_envs * horizon / dt
     out = {
         "workload": "IMPALA+NatureCNN jax:pong pixels (BASELINE ⑤ class)",
@@ -216,11 +248,18 @@ def ppo_cnn_nut_pixels() -> dict:
     for _ in range(WARMUP):
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
-    jax.block_until_ready(metrics)
+    jax.device_get(metrics)
     flops = _iter_flops(trainer._train_iter, state, carry, key)
-    dt, _ = _timeit(
-        lambda s, c, k: trainer._train_iter(s, c, k)[2], state, carry, key=key
-    )
+
+    def fused_step(sc, k):
+        s, c = sc
+        s, c, m = trainer._train_iter(s, c, k)
+        return (s, c), m
+
+    # throwaway window first: freshly compiled programs show a one-time
+    # multi-second tunnel artifact on their first timed window
+    _, sc_w = _timeit_chained(fused_step, (state, carry), key, iters=2)
+    dt, _ = _timeit_chained(fused_step, sc_w, key)
     sps = ITERS * num_envs * horizon / dt
     out = {
         "workload": "PPO+NatureCNN jax:nut_pixels (BASELINE ④ class, on-device rendering)",
@@ -281,14 +320,91 @@ def ddpg_prioritized_lift() -> dict:
     }
 
 
-def main() -> None:
+def headline_scaling() -> list[dict]:
+    """Throughput vs geometry for the headline workload — how far the
+    batch amortizes per-iteration dispatch before compute saturates."""
+    from surreal_tpu.launch.rollout import init_device_carry
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
     rows = []
+    for num_envs, horizon in (
+        (1024, 256), (2048, 256), (4096, 256), (8192, 256), (16384, 256)
+    ):
+        cfg = Config(
+            learner_config=Config(
+                algo=Config(name="ppo", horizon=horizon, epochs=4, num_minibatches=4),
+            ),
+            env_config=Config(name="jax:lift", num_envs=num_envs),
+            session_config=Config(
+                folder="/tmp/perf_scaling",
+                metrics=Config(every_n_iters=10_000),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+            ),
+        ).extend(base_config())
+        trainer = Trainer(cfg)
+        key = jax.random.key(0)
+        key, init_key, env_key = jax.random.split(key, 3)
+        state = trainer.learner.init(init_key)
+        carry = init_device_carry(trainer.env, env_key, num_envs)
+        for _ in range(WARMUP):
+            key, it_key = jax.random.split(key)
+            state, carry, metrics = trainer._train_iter(state, carry, it_key)
+        jax.device_get(metrics)
+
+        def fused_step(sc, k, _t=trainer):
+            s, c = sc
+            s, c, m = _t._train_iter(s, c, k)
+            return (s, c), m
+
+        # per-geometry throwaway window: freshly compiled programs show a
+        # one-time multi-second tunnel warmup on their first timed window
+        _, sc_w = _timeit_chained(fused_step, (state, carry), key, iters=2)
+        dt, _ = _timeit_chained(fused_step, sc_w, key)
+        rows.append(
+            {
+                "geometry": f"{num_envs} x {horizon}",
+                "env_steps_per_s": ITERS * num_envs * horizon / dt,
+                "iter_ms": dt / ITERS * 1e3,
+            }
+        )
+        print(json.dumps(rows[-1], default=float))
+    return rows
+
+
+def _capture_trace(trainer, state, carry, key) -> str | None:
+    """Profiler window over two fused iters (SURVEY.md §5.1). MUST run
+    after every measurement: see the axon post-trace-compilation note."""
+    trace_dir = "/tmp/perf_lift/profile"
+    try:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(2):
+                key, it_key = jax.random.split(key)
+                state, carry, metrics = trainer._train_iter(state, carry, it_key)
+            jax.block_until_ready(metrics)
+        return trace_dir
+    except Exception:
+        return None
+
+
+def main(argv=None) -> None:
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    rows = []
+    trace_fn = None
     for fn in (
         ppo_lift_headline, impala_pong, ddpg_prioritized_lift, ppo_cnn_nut_pixels
     ):
         r = fn()
+        trace_fn = r.pop("_trace_fn", None) or trace_fn  # not JSON-able
         rows.append(r)
         print(json.dumps(r, default=float))
+    scaling = headline_scaling() if "--scaling" in argv else None
+    # trace LAST: everything compiled after a trace window runs degraded
+    rows[0]["trace_dir"] = trace_fn() if trace_fn else None
 
     dev = jax.devices()[0]
     lines = [
@@ -300,10 +416,13 @@ def main() -> None:
         "compiled training iteration — model + env + optimizer, everything "
         "in the program.",
         "",
-        "RL env-step workloads are usually not matmul-bound (small MLPs, "
-        "env physics, scatter-heavy replay) — MFU here says what fraction "
-        "of the chip the headline steps/s actually uses; the graded metric "
-        "stays env steps/s/chip.",
+        "All timings are fenced by `jax.device_get` of a program output — "
+        "`jax.block_until_ready` does not wait on this backend, which "
+        "inflated pre-round-3 records ~1000x (bench.py module doc has the "
+        "forensics). These workloads are LATENCY-BOUND on long scans of "
+        "tiny elementwise env ops, not matmul-bound — MFU is expectedly "
+        "tiny and reported for transparency; the graded metric stays env "
+        "steps/s/chip.",
         "",
         "| Workload | Geometry | env steps/s/chip | iter ms | FLOP/s | MFU |",
         "|---|---|---|---|---|---|",
@@ -351,6 +470,28 @@ def main() -> None:
         "",
         verdict,
     ]
+    if scaling:
+        lines += [
+            "",
+            "## Headline geometry scaling (`--scaling`)",
+            "",
+            "| Geometry (envs x horizon) | env steps/s/chip | iter ms |",
+            "|---|---|---|",
+        ]
+        for r in scaling:
+            lines.append(
+                f"| {r['geometry']} | {r['env_steps_per_s']:,.0f} "
+                f"| {r['iter_ms']:.2f} |"
+            )
+        lines += [
+            "",
+            "Horizon costs linearly (the env scan is sequential) and width "
+            "costs linearly beyond ~2k envs (elementwise ops saturate), so "
+            "throughput is flat-to-declining past the knee. bench.py "
+            "records the headline at its own swept knee (2048 x 128, "
+            "~3.2M steps/s); this sweep holds horizon at 256 to show the "
+            "width axis in isolation.",
+        ]
     if head.get("trace_dir"):
         lines += [
             "",

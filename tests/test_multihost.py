@@ -192,12 +192,15 @@ def _kill_tree(pid: int) -> None:
             kids = [int(c) for c in f.read().split()]
     except OSError:
         kids = []
-    for kid in kids:
-        _kill_tree(kid)
+    # parent FIRST: a still-alive SEED rank actively respawns dead
+    # workers, so killing children first can leak a fresh orphan spawned
+    # between enumeration and the parent's own SIGKILL
     try:
         os.kill(pid, signal.SIGKILL)
     except ProcessLookupError:
         pass
+    for kid in kids:
+        _kill_tree(kid)
 
 
 def _watch_then_kill(procs, ckpt_dir, timeout_s: float):
