@@ -92,7 +92,7 @@ def ppo_lift_headline() -> dict:
     for _ in range(WARMUP):
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
-    jax.block_until_ready(metrics)
+    jax.device_get(metrics)
     flops = _iter_flops(trainer._train_iter, state, carry, key)
 
     def fused_step(sc, k):
@@ -104,7 +104,7 @@ def ppo_lift_headline() -> dict:
     # shown a ~10x one-time tunnel warmup artifact (observed: 3967 ms/iter
     # first window vs 400 ms/iter for the identical geometry later in the
     # same process); record the steady window
-    _, (state, carry) = _timeit_chained(fused_step, (state, carry), key)
+    _, (state, carry) = _timeit_chained(fused_step, (state, carry), key, iters=2)
     dt, (state, carry) = _timeit_chained(fused_step, (state, carry), key)
     sps = ITERS * num_envs * horizon / dt
 
@@ -117,7 +117,7 @@ def ppo_lift_headline() -> dict:
     )
     key, rk = jax.random.split(key)
     carry2, batch = roll(state, carry, rk)
-    jax.block_until_ready(batch)
+    jax.device_get(batch["reward"][-1])
 
     def roll_step(c, k):
         c2, b = roll(state, c, k)
@@ -126,7 +126,7 @@ def ppo_lift_headline() -> dict:
         # to the rollout — observed before this slice was added
         return c2, b["reward"][-1]
 
-    _, carry_w = _timeit_chained(roll_step, carry, key)  # throwaway window
+    _, carry_w = _timeit_chained(roll_step, carry, key, iters=2)  # throwaway
     dt_roll, _ = _timeit_chained(roll_step, carry_w, key)
 
     learn_batch = {
@@ -137,13 +137,13 @@ def ppo_lift_headline() -> dict:
     learn = jax.jit(trainer.learner.learn)
     key, lk = jax.random.split(key)
     s2, m2 = learn(state, learn_batch, lk)
-    jax.block_until_ready(m2)
+    jax.device_get(m2["loss/pg"])
 
     def learn_step(s, k):
         s2, m = learn(s, learn_batch, k)
         return s2, m
 
-    _, state_w = _timeit_chained(learn_step, state, key)  # throwaway window
+    _, state_w = _timeit_chained(learn_step, state, key, iters=2)  # throwaway
     dt_learn, _ = _timeit_chained(learn_step, state_w, key)
 
     # NOTE: no jax.profiler.trace here — on the axon backend a trace
@@ -383,7 +383,7 @@ def _capture_trace(trainer, state, carry, key) -> str | None:
             for _ in range(2):
                 key, it_key = jax.random.split(key)
                 state, carry, metrics = trainer._train_iter(state, carry, it_key)
-            jax.block_until_ready(metrics)
+            jax.device_get(metrics)  # real fence: trace must span execution
         return trace_dir
     except Exception:
         return None

@@ -187,14 +187,19 @@ def _kill_tree(pid: int) -> None:
     budget and load the box under the next phase)."""
     import signal
 
+    # freeze the parent FIRST: a live SEED rank actively respawns dead
+    # workers, so any enumerate/kill ordering without a freeze races a
+    # respawn; a SIGSTOPped parent cannot spawn, making the child list
+    # stable until its SIGKILL lands
+    try:
+        os.kill(pid, signal.SIGSTOP)
+    except ProcessLookupError:
+        pass
     try:
         with open(f"/proc/{pid}/task/{pid}/children") as f:
             kids = [int(c) for c in f.read().split()]
     except OSError:
         kids = []
-    # parent FIRST: a still-alive SEED rank actively respawns dead
-    # workers, so killing children first can leak a fresh orphan spawned
-    # between enumeration and the parent's own SIGKILL
     try:
         os.kill(pid, signal.SIGKILL)
     except ProcessLookupError:

@@ -423,3 +423,45 @@ def test_device_eval_records_video(tmp_path):
         assert any(f.startswith("episode_") for f in files), files
     finally:
         ev.close()
+
+
+# -- driver artifact contract ------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_prints_one_valid_json_line(tmp_path):
+    """bench.py is the driver's graded artifact: it must run (CPU sim
+    here), print exactly one JSON line, and carry the contract keys with
+    sane values (the round-3 measurement-integrity fix lives or dies by
+    this surface staying honest)."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + repo
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import bench; bench.main()"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, cwd=repo, timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "env_steps_per_sec_per_chip_ppo_fused_blocklift"
+    assert rec["unit"] == "env_steps/s/chip"
+    assert rec["value"] > 0
+    # abs tolerance = half-ulp of bench.py's 3-dp rounding (rel alone is
+    # tighter than the rounding error at CPU-sim magnitudes)
+    assert rec["vs_baseline"] == pytest.approx(
+        rec["value"] / 100_000, abs=5e-4
+    )
+    # FLOP sanity: the honest-measurement guard — implied FLOP/s must stay
+    # below any physically possible rate (CPU sim is far below TPU peak)
+    if "model_flops_per_s" in rec:
+        assert rec["model_flops_per_s"] < 197e12
+        assert 0 <= rec["mfu"] < 1.0
