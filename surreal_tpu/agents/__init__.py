@@ -3,5 +3,18 @@
 from surreal_tpu.agents.base import AGENT_MODES, Agent
 from surreal_tpu.agents.ppo_agent import PPOAgent
 from surreal_tpu.agents.ddpg_agent import DDPGAgent
+from surreal_tpu.learners.base import TRAINING, Learner
 
-__all__ = ["AGENT_MODES", "Agent", "PPOAgent", "DDPGAgent"]
+
+def make_agent(learner: Learner, mode: str = TRAINING) -> Agent:
+    """Learner -> its agent class (parity: the reference's per-algo agent
+    registry in ``surreal/agent/__init__.py``). The algo name is read from
+    the learner's extended config, so callers that only hold a learner
+    (SessionHooks' publisher, the actor CLI) get the right wire view —
+    DDPG's actor-only view, PPO's version-stamping remote act."""
+    name = learner.config.algo.name
+    cls = {"ppo": PPOAgent, "ddpg": DDPGAgent, "impala": PPOAgent}.get(name, Agent)
+    return cls(learner, mode)
+
+
+__all__ = ["AGENT_MODES", "Agent", "PPOAgent", "DDPGAgent", "make_agent"]

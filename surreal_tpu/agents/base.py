@@ -39,11 +39,18 @@ class Agent:
         self.learner = learner
         self.mode = mode
         self._client = None
+        self._jit_act = None
         self.state = None  # local state copy; remote path only
 
     def act(self, state, obs: jax.Array, key: jax.Array):
-        """Batched action + behavior ``action_info`` from learner state."""
-        return self.learner.act(state, obs, key, self.mode)
+        """Batched action + behavior ``action_info`` from learner state.
+        Jit-cached per agent (standalone actor processes step this once
+        per env step; inside an outer jit the inner jit just inlines)."""
+        if self._jit_act is None:
+            from functools import partial
+
+            self._jit_act = jax.jit(partial(self.learner.act, mode=self.mode))
+        return self._jit_act(state, obs, key)
 
     def eval_view(self, deterministic: bool = True) -> "Agent":
         return type(self)(
@@ -79,6 +86,14 @@ class Agent:
         the staleness signal callers bound against the publisher's
         version."""
         return 0 if self._client is None else self._client.version
+
+    def peek_published_version(self, timeout_ms: int = 5000) -> int:
+        """The server's latest PUBLISHED version without transferring the
+        blob (0 if nothing published) — the cheap wait-until-warm poll.
+        Raises TimeoutError on a silent server, like ``fetch``."""
+        if self._client is None:
+            raise RuntimeError("peek_published_version before connect()")
+        return self._client.peek_version(timeout_ms)
 
     def fetch_params(self) -> bool:
         """Fetch now. Returns True if a published view was merged.

@@ -91,16 +91,34 @@ class ParameterServer:
         while not self._stop.is_set():
             for sock, _ in poller.poll(timeout=50):
                 if sock is self._sub:
-                    _, ver, blob = self._sub.recv_multipart()
-                    with self._lock:
-                        self._latest = (int.from_bytes(ver, "little"), blob)
+                    # drain to the NEWEST snapshot: a fused trainer can
+                    # publish hundreds of versions/s, far outpacing this
+                    # thread — serving anything but the latest would add
+                    # staleness, and leaving the backlog queued grows the
+                    # SUB buffer without bound (observed: ~minutes of
+                    # publishing at cadence 1 starved REP replies entirely)
+                    latest = None
+                    while self._sub.poll(0):
+                        latest = self._sub.recv_multipart()
+                    if latest is not None:
+                        _, ver, blob = latest
+                        with self._lock:
+                            self._latest = (int.from_bytes(ver, "little"), blob)
                 elif sock is self._rep:
-                    self._rep.recv()  # any request payload = "give me latest"
+                    req = self._rep.recv()
                     with self._lock:
                         latest = self._latest
                     if latest is None:
                         self._rep.send_multipart([b"none", b""])
-                    else:
+                    elif req == b"version":
+                        # version-only probe: lets clients poll for a
+                        # fresh/minimum version without shipping (and
+                        # deserializing) the full blob every poll
+                        ver, _ = latest
+                        self._rep.send_multipart(
+                            [ver.to_bytes(8, "little"), b""]
+                        )
+                    else:  # any other payload = "give me latest"
                         ver, blob = latest
                         self._rep.send_multipart(
                             [ver.to_bytes(8, "little"), blob]
@@ -169,22 +187,32 @@ class ParameterClient:
         self.template = template
         self.version = 0
 
-    def fetch(self, timeout_ms: int = 5000) -> Any | None:
-        """Returns the latest params pytree, or None if nothing published
-        yet. Raises TimeoutError on a silent server — after RECOVERING the
-        REQ socket (a strict REQ with an outstanding send would fail every
-        later fetch with EFSM), so callers may simply retry."""
-        self._req.send(b"fetch")
+    def _request(self, payload: bytes, timeout_ms: int):
+        self._req.send(payload)
         if not self._req.poll(timeout_ms):
             self._req.close(0)
             self._req = self._ctx.socket(zmq.REQ)
             self._req.connect(self._address)
             raise TimeoutError("parameter server did not reply")
-        ver, blob = self._req.recv_multipart()
+        return self._req.recv_multipart()
+
+    def fetch(self, timeout_ms: int = 5000) -> Any | None:
+        """Returns the latest params pytree, or None if nothing published
+        yet. Raises TimeoutError on a silent server — after RECOVERING the
+        REQ socket (a strict REQ with an outstanding send would fail every
+        later fetch with EFSM), so callers may simply retry."""
+        ver, blob = self._request(b"fetch", timeout_ms)
         if ver == b"none":
             return None
         self.version = int.from_bytes(ver, "little")
         return loads_pytree(self.template, blob)
+
+    def peek_version(self, timeout_ms: int = 5000) -> int:
+        """Latest PUBLISHED version without transferring the blob (0 if
+        nothing published yet) — the cheap poll for wait-until-version
+        loops. Does not advance :attr:`version` (nothing was fetched)."""
+        ver, _ = self._request(b"version", timeout_ms)
+        return 0 if ver == b"none" else int.from_bytes(ver, "little")
 
     def close(self) -> None:
         self._req.close(0)

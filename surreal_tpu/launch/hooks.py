@@ -77,6 +77,48 @@ class SessionHooks:
                 "eval/return" if self.evaluator else "episode/return"
             )
 
+        # live parameter publishing (reference §3.4: the learner published
+        # every publish_interval; external actors/evals attach to the run).
+        # Multi-host drivers construct hooks on rank 0 only, so publishing
+        # is single-controller for free.
+        self._publisher = None
+        self._param_server = None
+        pub = cfg.get("publish", None)
+        if pub is not None and pub.enabled:
+            from surreal_tpu.agents import make_agent
+            from surreal_tpu.distributed.param_service import (
+                ParameterPublisher,
+                ParameterServer,
+            )
+
+            self._pub_agent = make_agent(learner)
+            self._publisher = ParameterPublisher()
+            self._param_server = ParameterServer(
+                self._publisher.address, bind=pub.bind
+            )
+            self._pub_every = PeriodicTracker(max(1, pub.every_n_iters))
+            # discovery file: how `surreal_tpu actor` / `eval --follow`
+            # find a live session without the operator copying ports
+            # around. Written atomically (tmp + rename): pollers race this
+            # write, and a half-written json would crash them mid-read.
+            import json
+
+            self._discovery_path = os.path.join(cfg.folder, "param_server.json")
+            tmp_path = self._discovery_path + ".tmp"
+            with open(tmp_path, "w") as f:
+                json.dump(
+                    {
+                        "addresses": self._param_server.addresses,
+                        "publisher": self._publisher.address,
+                    },
+                    f,
+                )
+            os.replace(tmp_path, self._discovery_path)
+            self.log.info(
+                "parameter server live at %s (publish every %d iters)",
+                self._param_server.addresses, self._pub_every.period,
+            )
+
         prof = cfg.profiler
         self._prof_enabled = bool(prof.enabled)
         self._prof_start = int(prof.start_iter)
@@ -154,6 +196,10 @@ class SessionHooks:
             self._eval_every = PeriodicTracker(
                 self._eval_every.period, init_count=iteration
             )
+        if self._publisher is not None:
+            self._pub_every = PeriodicTracker(
+                self._pub_every.period, init_count=iteration
+            )
 
     # -- per-iteration -------------------------------------------------------
     def begin_run(self, iteration: int, env_steps: int) -> None:
@@ -202,6 +248,13 @@ class SessionHooks:
                 time.time() - (self._t0 or time.time()), 1e-9
             )
             self._last_train = m
+        if self._publisher is not None and self._pub_every.track_increment():
+            version = self._publisher.publish(
+                self._pub_agent.acting_view(resolve_state())
+            )
+            if m is not None:
+                m["publish/version"] = float(version)
+                self._last_train = m
         evaled: dict[str, float] = {}
         if self.evaluator is not None and self._eval_every.track_increment():
             evaled = self.evaluator.evaluate(resolve_state(), key)
@@ -257,6 +310,19 @@ class SessionHooks:
         if self._prof_active:
             jax.profiler.stop_trace()
             self._prof_active = False
+        if self._param_server is not None:
+            self._param_server.close()
+            self._param_server = None
+            # a dead session must not advertise its ports: a relaunched
+            # actor would otherwise latch onto the stale address and spend
+            # its whole wait budget timing out against it
+            try:
+                os.unlink(self._discovery_path)
+            except OSError:
+                pass
+        if self._publisher is not None:
+            self._publisher.close()
+            self._publisher = None
         if self.evaluator is not None:
             self.evaluator.close()
         if self.ckpt is not None:

@@ -13,12 +13,18 @@ are modules of ONE SPMD program, so the launcher's job collapses to:
 
 Component-role map (for auditability against the reference dispatch):
     run_agent / run_agent-batch -> rollout collectors inside the driver
-                                   (launch/rollout.py, SEED inference server)
+                                   (launch/rollout.py, SEED inference server);
+                                   standalone: `surreal_tpu actor` vs a live
+                                   session's parameter server
     run_learner                 -> learner step inside the driver
     run_replay                  -> HBM replay (replay/) inside the driver
     run_ps                      -> device-resident params (no process); host
-                                   plane: distributed/param_service.py
-    run_eval(s)                 -> launch/evaluator.py via SessionHooks
+                                   plane: distributed/param_service.py, LIVE
+                                   via session_config.publish (SessionHooks
+                                   publishes the acting view every N iters)
+    run_eval(s)                 -> launch/evaluator.py via SessionHooks;
+                                   standalone: `surreal_tpu eval` (checkpoint)
+                                   or `eval --follow` (live published params)
     run_tensorboard/tensorplex/loggerplex -> session/metrics.py writers
     tmux/kube/subproc cluster   -> session_config.topology (mesh axes +
                                    env-worker processes), no external CLI
@@ -117,7 +123,123 @@ def select_trainer(config):
     return Trainer(config)
 
 
+def spawn_rank(
+    cli_argv,
+    rank: int,
+    num_processes: int,
+    coordinator: str,
+    *,
+    env: dict | None = None,
+    stdout=None,
+    stderr=None,
+    cwd=None,
+):
+    """Spawn ONE rank of a ``surreal_tpu`` process group as an OS process
+    carrying the jax.distributed env-var contract
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID — the
+    GKE/xmanager launcher shape that ``parallel/multihost.py`` consumes as
+    its config fallback). Shared by the ``--local-procs`` supervisor and
+    the multi-host test harness, so product and tests launch ranks the
+    same way."""
+    import subprocess
+
+    e = dict(os.environ if env is None else env)
+    e["JAX_COORDINATOR_ADDRESS"] = coordinator
+    e["JAX_NUM_PROCESSES"] = str(num_processes)
+    e["JAX_PROCESS_ID"] = str(rank)
+    return subprocess.Popen(
+        [sys.executable, "-m", "surreal_tpu", *cli_argv],
+        env=e, stdout=stdout, stderr=stderr, cwd=cwd, text=True,
+    )
+
+
+def _strip_local_procs(argv):
+    """Child ranks run the SAME command minus the supervisor flag."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+        elif a == "--local-procs":
+            skip = True
+        elif not a.startswith("--local-procs="):
+            out.append(a)
+    return out
+
+
+def _run_local_group(args) -> int:
+    """One-command process groups (parity: the reference's symphony /
+    ``surreal-subproc`` CLI materialized the whole experiment's process
+    group with one command, SURVEY.md §3.1): spawn N ranks of THIS train
+    command locally, wire the coordinator, forward signals, reap children.
+    Rank 0 inherits this terminal; ranks > 0 log to <folder>/rank<i>.log.
+    A non-zero child exit tears the whole group down (a half-dead process
+    group would deadlock the survivors' next collective)."""
+    import signal
+    import socket
+    import subprocess
+    import time
+
+    n = int(args.local_procs)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    child_argv = _strip_local_procs(args.raw_argv)
+    os.makedirs(args.folder, exist_ok=True)
+    procs, logs = [], []
+    try:
+        for i in range(n):
+            if i == 0:
+                out_i, err_i = None, None  # rank 0 owns this terminal
+            else:
+                f = open(os.path.join(args.folder, f"rank{i}.log"), "w")
+                logs.append(f)
+                out_i, err_i = f, subprocess.STDOUT
+            procs.append(
+                spawn_rank(child_argv, i, n, f"127.0.0.1:{port}",
+                           stdout=out_i, stderr=err_i)
+            )
+
+        def forward(sig, _frame):
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(sig)
+
+        old = {
+            s_: signal.signal(s_, forward)
+            for s_ in (signal.SIGINT, signal.SIGTERM)
+        }
+        try:
+            while True:
+                codes = [p.poll() for p in procs]
+                bad = next((c for c in codes if c not in (None, 0)), None)
+                if bad is not None:
+                    for p in procs:
+                        if p.poll() is None:
+                            p.terminate()
+                    deadline = time.monotonic() + 10
+                    for p in procs:
+                        while p.poll() is None and time.monotonic() < deadline:
+                            time.sleep(0.1)
+                        if p.poll() is None:
+                            p.kill()
+                    return int(bad)
+                if all(c == 0 for c in codes):
+                    return 0
+                time.sleep(0.2)
+        finally:
+            for s_, h in old.items():
+                signal.signal(s_, h)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            f.close()
+
+
 def run_train(args) -> int:
+    if getattr(args, "local_procs", None) and args.local_procs > 1:
+        return _run_local_group(args)
     config = build_config(args)
     _apply_backend(config.session_config.backend)
     # must precede first jax use: joins this process into the global
@@ -142,11 +264,13 @@ def run_train(args) -> int:
     if rank0:
         os.makedirs(config.session_config.folder, exist_ok=True)
         # persist the resolved config so `eval` (and future resumes) can
-        # rebuild the exact learner/env without re-supplying CLI flags
-        with open(
-            os.path.join(config.session_config.folder, "config.json"), "w"
-        ) as f:
+        # rebuild the exact learner/env without re-supplying CLI flags.
+        # tmp + rename: actor/eval processes poll for this file and must
+        # never observe a half-written json
+        cfg_path = os.path.join(config.session_config.folder, "config.json")
+        with open(cfg_path + ".tmp", "w") as f:
             f.write(config.dumps())
+        os.replace(cfg_path + ".tmp", cfg_path)
     if multihost:
         if config.session_config.topology.num_env_workers > 0:
             from surreal_tpu.launch.multihost_trainer import MultiHostSEEDTrainer
@@ -170,8 +294,225 @@ def run_train(args) -> int:
     return 0
 
 
+def _load_session_config(folder: str, wait_s: float = 0.0):
+    """Read the session's persisted config.json; with ``wait_s`` poll for
+    it (actor/eval processes may launch before the trainer wrote it).
+    Writes are atomic (tmp+rename), but sessions trained by older builds
+    may have written in place — treat a bad parse as not-there-yet."""
+    import time
+
+    cfg_path = os.path.join(folder, "config.json")
+    deadline = time.monotonic() + wait_s
+    while True:
+        if os.path.exists(cfg_path):
+            try:
+                with open(cfg_path) as f:
+                    return Config(json.load(f))
+            except (json.JSONDecodeError, OSError):
+                pass
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.2)
+
+
+def _discover_param_server(folder: str, connect: str | None, wait_s: float) -> str:
+    """Resolve the live session's parameter-server address: --connect wins;
+    otherwise poll <folder>/param_server.json (written by SessionHooks when
+    session_config.publish.enabled)."""
+    import time
+
+    if connect:
+        return connect
+    path = os.path.join(folder, "param_server.json")
+    deadline = time.monotonic() + wait_s
+    while True:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    return json.load(f)["addresses"][0]
+            except (json.JSONDecodeError, OSError, KeyError, IndexError):
+                pass  # racing the atomic replace; retry
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"no {path} after {wait_s:.0f}s — is a training session "
+                "with session_config.publish.enabled=true running? "
+                "(or pass --connect tcp://host:port)"
+            )
+        time.sleep(0.2)
+
+
+_ACTOR_MODES = {
+    "training": "training",
+    "deterministic": "eval_deterministic",
+    "stochastic": "eval_stochastic",
+}
+
+
+def _wait_for_publish(
+    agent, folder, connect, address, wait_s, *, min_version=1, fetch_every=1
+):
+    """Block until a published view with version >= ``min_version`` has
+    been FETCHED into ``agent``. Polls with version-only probes (no blob
+    transfer), and — unless the address was pinned with --connect —
+    re-resolves the discovery file between retries, so a stale
+    param_server.json from a dead session cannot eat the wait budget once
+    a new session rewrites it. Returns True on success, False on
+    timeout."""
+    import time
+
+    deadline = time.monotonic() + wait_s
+    while True:
+        try:
+            if (
+                agent.peek_published_version(timeout_ms=2000) >= min_version
+                and agent.fetch_params()
+            ):
+                return True
+        except TimeoutError:
+            pass
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.3)
+        if not connect:
+            try:
+                new_addr = _discover_param_server(folder, None, 0.0)
+            except TimeoutError:
+                continue
+            if new_addr != address:
+                address = new_addr
+                state = agent.state
+                agent.close()
+                agent.connect(address, state, fetch_every=fetch_every)
+
+
+def run_actor(args) -> int:
+    """Standalone actor process against a LIVE training session (parity:
+    reference ``run_agent`` — a separate OS process acting with params
+    periodically re-fetched from the parameter server, SURVEY.md §3.2).
+
+    Prints one JSON line per finished episode ({episode, return, length,
+    param_version}) and a final summary line; ``actor/versions_seen`` > 1
+    is the proof the actor tracked a LIVE learner, not a snapshot."""
+    config = _load_session_config(args.folder, wait_s=args.wait)
+    if config is None:
+        print(f"no config.json under {args.folder!r} (launch training first)",
+              file=sys.stderr)
+        return 2
+    _apply_backend(config.session_config.get("backend", "tpu"))
+    address = _discover_param_server(args.folder, args.connect, args.wait)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from surreal_tpu.agents import make_agent
+    from surreal_tpu.envs import is_jax_env, make_env
+    from surreal_tpu.learners import build_learner
+
+    env_cfg = config.env_config
+    if args.num_envs is not None:
+        env_cfg = Config(num_envs=args.num_envs).extend(env_cfg)
+    if args.video_dir:
+        if env_cfg.name.startswith("jax:"):
+            raise ValueError(
+                "--video-dir records through the host VideoWrapper; device "
+                "(jax:*) env episodes are recorded by eval's state renderer "
+                "(env_config.video on the training session) instead"
+            )
+        env_cfg = Config(
+            video=Config(enabled=True, dir=args.video_dir, every_n_episodes=1)
+        ).extend(env_cfg)
+    env = make_env(env_cfg)
+    learner = build_learner(config.learner_config, env.specs)
+    agent = make_agent(learner, _ACTOR_MODES[args.mode])
+    agent.connect(
+        address, learner.init(jax.random.key(args.seed)),
+        fetch_every=args.fetch_every,
+    )
+    # block until a published view >= --min-version lands (the learner may
+    # still be compiling its first iterations; min-version lets an actor
+    # wait for a warm policy instead of acting from the init snapshot)
+    if not _wait_for_publish(
+        agent, args.folder, args.connect, address, args.wait,
+        min_version=max(1, args.min_version), fetch_every=args.fetch_every,
+    ):
+        print(
+            f"nothing published (>= version {args.min_version}) on "
+            f"{address} after {args.wait:.0f}s",
+            file=sys.stderr,
+        )
+        return 2
+
+    B = env_cfg.num_envs
+    key = jax.random.key(args.seed + 1)
+    ep_ret = np.zeros(B, np.float64)
+    ep_len = np.zeros(B, np.int64)
+    episodes_done = 0
+    versions_seen: set[int] = set()
+
+    def on_step(reward: np.ndarray, done: np.ndarray) -> None:
+        nonlocal episodes_done
+        ep_ret[:] += reward
+        ep_len[:] += 1
+        versions_seen.add(agent.param_version)
+        for i in np.nonzero(done)[0]:
+            episodes_done += 1
+            print(json.dumps({
+                "episode": episodes_done,
+                "return": float(ep_ret[i]),
+                "length": int(ep_len[i]),
+                "param_version": agent.param_version,
+            }), flush=True)
+            ep_ret[i] = 0.0
+            ep_len[i] = 0
+        if hasattr(agent, "mask_noise_on_reset"):
+            # DDPG's OU exploration state must not leak across resets
+            agent.mask_noise_on_reset(done)
+
+    act_steps = 0  # across the batch: each loop pass acts B envs
+    cap = args.max_steps if args.max_steps is not None else 10**9
+    final_version = agent.param_version
+    try:
+        if is_jax_env(env):
+            from surreal_tpu.envs.jax.base import batch_reset, batch_step
+
+            key, rkey = jax.random.split(key)
+            env_state, obs = batch_reset(env, jax.random.split(rkey, B))
+            step_fn = jax.jit(lambda s, a: batch_step(env, s, a))
+            while episodes_done < args.episodes and act_steps < cap:
+                key, akey = jax.random.split(key)
+                action, _ = agent.remote_act(obs, akey)
+                env_state, obs, reward, done, _ = step_fn(env_state, action)
+                on_step(np.asarray(reward), np.asarray(done))
+                act_steps += B
+        else:
+            obs = env.reset(seed=env_cfg.seed)
+            while episodes_done < args.episodes and act_steps < cap:
+                key, akey = jax.random.split(key)
+                action, _ = agent.remote_act(jnp.asarray(obs), akey)
+                out = env.step(np.asarray(action))
+                on_step(out.reward, out.done)
+                obs = out.obs
+                act_steps += B
+    finally:
+        final_version = max(final_version, agent.param_version)
+        agent.close()
+        if hasattr(env, "close"):
+            env.close()
+    print(json.dumps({
+        "actor/episodes": episodes_done,
+        "actor/steps": act_steps,
+        "actor/param_version": final_version,
+        "actor/versions_seen": len(versions_seen),
+    }), flush=True)
+    return 0
+
+
 def run_eval(args) -> int:
-    """Score a trained session folder (reference ``run_eval`` as a CLI)."""
+    """Score a trained session folder (reference ``run_eval`` as a CLI) —
+    or, with ``--follow``, attach to a LIVE session's parameter server and
+    score freshly-fetched params each round (the reference's standing eval
+    workers, SURVEY.md §3.5)."""
     import jax
 
     from surreal_tpu.envs import make_env
@@ -179,13 +520,13 @@ def run_eval(args) -> int:
     from surreal_tpu.learners import build_learner
     from surreal_tpu.session.checkpoint import CheckpointManager
 
-    cfg_path = os.path.join(args.folder, "config.json")
-    if not os.path.exists(cfg_path):
+    config = _load_session_config(
+        args.folder, wait_s=args.wait if args.follow else 0.0
+    )
+    if config is None:
         print(f"no config.json under {args.folder!r} (was it trained via the CLI?)",
               file=sys.stderr)
         return 2
-    with open(cfg_path) as f:
-        config = Config(json.load(f))
     # eval must run on the backend the session trained on; sessions saved
     # before the backend knob existed default to tpu (the old behavior)
     _apply_backend(config.session_config.get("backend", "tpu"))
@@ -193,6 +534,42 @@ def run_eval(args) -> int:
     learner = build_learner(config.learner_config, probe.specs)
     if hasattr(probe, "close"):
         probe.close()
+
+    eval_cfg = Config(
+        episodes=args.episodes, mode=args.mode, max_steps=args.max_steps
+    )
+    if args.follow:
+        import time
+
+        from surreal_tpu.agents import make_agent
+
+        address = _discover_param_server(args.folder, args.connect, args.wait)
+        agent = make_agent(learner, _ACTOR_MODES[args.mode])
+        agent.connect(address, learner.init(jax.random.key(0)))
+        if not _wait_for_publish(
+            agent, args.folder, args.connect, address, args.wait
+        ):
+            print(f"nothing published on {address} after {args.wait:.0f}s",
+                  file=sys.stderr)
+            agent.close()
+            return 2
+        ev = Evaluator(config.env_config, eval_cfg, learner)
+        try:
+            for rnd in range(args.rounds):
+                if rnd:
+                    agent.fetch_params()  # freshest published view per round
+                out = ev.evaluate(
+                    agent.state,
+                    jax.random.fold_in(jax.random.key(args.seed), rnd),
+                )
+                out["param_version"] = agent.param_version
+                print(json.dumps(
+                    {k: v for k, v in sorted(out.items())}, default=float
+                ), flush=True)
+        finally:
+            ev.close()
+            agent.close()
+        return 0
 
     mgr = CheckpointManager(config.session_config.folder)
     template = learner.init(jax.random.key(0))
@@ -207,9 +584,6 @@ def run_eval(args) -> int:
     state, meta = restored
     mgr.close()
 
-    eval_cfg = Config(
-        episodes=args.episodes, mode=args.mode, max_steps=args.max_steps
-    )
     ev = Evaluator(config.env_config, eval_cfg, learner)
     out = ev.evaluate(state, jax.random.key(args.seed))
     ev.close()
@@ -235,11 +609,17 @@ def main(argv=None) -> int:
     t.add_argument("--workers", type=int, default=None,
                    help="env-worker processes/threads for host envs (>0 "
                         "selects the SEED inference-server topology)")
+    t.add_argument("--local-procs", type=int, default=None,
+                   help="spawn this many multi-controller ranks locally as "
+                        "one process group (one-command multi-host; the "
+                        "reference's symphony/subproc role). Rank 0 owns "
+                        "this terminal, ranks>0 log to <folder>/rank<i>.log")
     t.add_argument("--set", nargs="*", metavar="KEY=VAL", default=[],
                    help="dotlist overrides, e.g. learner_config.algo.horizon=64")
     t.set_defaults(fn=run_train)
 
-    e = sub.add_parser("eval", help="evaluate a trained session folder")
+    e = sub.add_parser("eval", help="evaluate a trained session folder, or "
+                       "--follow a live session's parameter server")
     e.add_argument("--folder", required=True)
     e.add_argument("--episodes", type=int, default=10)
     e.add_argument("--mode", choices=("deterministic", "stochastic"),
@@ -250,9 +630,53 @@ def main(argv=None) -> int:
                    help="per-episode step cap (default: env time limit on "
                         "device envs, 10000 on host envs)")
     e.add_argument("--seed", type=int, default=0)
+    e.add_argument("--follow", action="store_true",
+                   help="score the LIVE session's published params instead "
+                        "of a checkpoint (needs session_config.publish)")
+    e.add_argument("--connect", default=None,
+                   help="parameter-server address (default: discover via "
+                        "<folder>/param_server.json)")
+    e.add_argument("--rounds", type=int, default=1,
+                   help="--follow only: eval rounds, re-fetching params "
+                        "each round")
+    e.add_argument("--wait", type=float, default=60.0,
+                   help="--follow only: seconds to wait for the live "
+                        "session's server / first publish")
     e.set_defaults(fn=run_eval)
 
+    a = sub.add_parser("actor", help="standalone actor against a live "
+                       "training session's parameter server")
+    a.add_argument("--folder", required=True,
+                   help="the live session's folder (config.json + "
+                        "param_server.json discovery)")
+    a.add_argument("--connect", default=None,
+                   help="parameter-server address (default: discover via "
+                        "<folder>/param_server.json)")
+    a.add_argument("--episodes", type=int, default=10)
+    a.add_argument("--fetch-every", type=int, default=100,
+                   help="re-fetch params every K acts (reference agents' "
+                        "periodic fetch)")
+    a.add_argument("--min-version", type=int, default=1,
+                   help="block until the published version reaches this "
+                        "before acting (wait out warmup/compiles)")
+    a.add_argument("--mode", choices=("training", "deterministic", "stochastic"),
+                   default="training")
+    a.add_argument("--num-envs", type=int, default=None,
+                   help="actor batch width (default: the session's "
+                        "env_config.num_envs)")
+    a.add_argument("--max-steps", type=int, default=None,
+                   help="total act-step cap across the batch (safety stop)")
+    a.add_argument("--video-dir", default=None,
+                   help="record episodes (host envs) via VideoWrapper")
+    a.add_argument("--wait", type=float, default=60.0,
+                   help="seconds to wait for the live session's config/"
+                        "server/first publish")
+    a.add_argument("--seed", type=int, default=0)
+    a.set_defaults(fn=run_actor)
+
     args = parser.parse_args(argv)
+    # the --local-procs supervisor re-issues this exact command per rank
+    args.raw_argv = list(sys.argv[1:] if argv is None else argv)
     return args.fn(args)
 
 

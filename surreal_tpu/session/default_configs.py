@@ -123,6 +123,20 @@ BASE_SESSION_CONFIG = Config(
         start_iter=20,     # after compile + warmup
         num_iters=5,
     ),
+    publish=Config(
+        # live parameter publishing (reference: the learner published every
+        # publish_interval and agents/evals attached to the running session,
+        # SURVEY.md §3.4/§2.1 PS row). When enabled the session starts a
+        # ParameterPublisher + ParameterServer and publishes the agent's
+        # acting view every N iterations; the server address lands in
+        # <folder>/param_server.json so `surreal_tpu actor` / `eval
+        # --follow` processes can discover it.
+        enabled=False,
+        every_n_iters=1,
+        bind="tcp://127.0.0.1:*",  # REP endpoint(s) served to actor/eval
+                                   # clients; set a real interface for
+                                   # cross-machine actors
+    ),
     seed=0,
 )
 

@@ -1,0 +1,173 @@
+"""Live parameter publishing: a training session with
+``session_config.publish.enabled`` starts a ParameterPublisher +
+ParameterServer, publishes the agent's acting view every N iterations, and
+standalone actor/eval processes attach over the wire (parity: reference
+learner ``publish_interval`` + ``run_agent``/``run_eval`` processes against
+the PS — SURVEY.md §3.2/§3.4/§3.5; VERDICT r3 missing #1/#2)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+
+
+def _session_config(tmp_path, **publish):
+    return Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=8, epochs=1, num_minibatches=1)
+        ),
+        env_config=Config(name="jax:pendulum", num_envs=8),
+        session_config=Config(
+            folder=str(tmp_path),
+            backend="cpu",
+            publish=Config(enabled=True, **publish),
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            eval=Config(every_n_iters=0),
+            checkpoint=Config(every_n_iters=10**9),
+        ),
+    ).extend(base_config())
+
+
+def test_hooks_publish_cadence_and_fetch(tmp_path):
+    """SessionHooks (the driver-shared side-band object) owns publishing:
+    the discovery file lands at init, the acting view goes out on the
+    configured cadence with a version bump, and a ParameterClient fetch
+    returns exactly the published params."""
+    from surreal_tpu.distributed.param_service import ParameterClient
+    from surreal_tpu.envs import make_env
+    from surreal_tpu.launch.hooks import SessionHooks
+    from surreal_tpu.learners import build_learner
+
+    config = _session_config(tmp_path, every_n_iters=2)
+    env = make_env(config.env_config)
+    learner = build_learner(config.learner_config, env.specs)
+    state = learner.init(jax.random.key(0))
+    hooks = SessionHooks(config, learner)
+    try:
+        info = json.load(open(tmp_path / "param_server.json"))
+        assert info["addresses"] and info["publisher"]
+        client = ParameterClient(
+            info["addresses"][0],
+            {"params": state.params, "obs_stats": state.obs_stats},
+        )
+        assert client.fetch() is None  # nothing published yet
+        hooks.begin_run(0, 0)
+        # cadence = 2: iteration 1 no publish, iteration 2 publishes
+        hooks.end_iteration(1, 64, state, jax.random.key(1), {})
+        state2 = state._replace(kl_beta=state.kl_beta + 1.0)
+        hooks.end_iteration(2, 128, state2, jax.random.key(2), {})
+        deadline = time.time() + 20
+        view = None
+        while view is None and time.time() < deadline:
+            view = client.fetch()
+            if view is None:
+                time.sleep(0.1)
+        assert view is not None and client.version == 1
+        # the published view is the acting slice of the CURRENT state
+        for a, b in zip(
+            jax.tree.leaves(view["params"]), jax.tree.leaves(state2.params)
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        client.close()
+    finally:
+        hooks.close()
+    # close() tears the server down AND retracts the advertisement — a
+    # dead session must not strand later actors on a stale address
+    assert not os.path.exists(tmp_path / "param_server.json")
+
+
+_SET_COMMON = [
+    "session_config.backend=cpu",
+    "learner_config.algo.horizon=8",
+    "learner_config.algo.epochs=1",
+    "learner_config.algo.num_minibatches=1",
+    "session_config.publish.enabled=true",
+    "session_config.metrics.every_n_iters=1",
+    "session_config.metrics.tensorboard=false",
+    "session_config.metrics.console=false",
+    "session_config.eval.every_n_iters=0",
+    "session_config.checkpoint.every_n_iters=1000000",
+    "env_config.time_limit=50",
+]
+
+
+def _cli_env():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = env.get("PYTHONPATH", "") + os.pathsep + repo
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    return env, repo
+
+
+@pytest.mark.slow
+def test_cli_live_actor_and_follow_eval(tmp_path):
+    """The round-3 VERDICT's done-bar: a CLI-launched training session and
+    separately-launched actor/eval processes meet over the wire; the
+    actor's param_version advances MID-RUN (>= 2 distinct versions seen)
+    and --follow eval returns flow."""
+    folder = tmp_path / "live"
+    env, repo = _cli_env()
+    trainer = subprocess.Popen(
+        [
+            sys.executable, "-m", "surreal_tpu", "train", "ppo",
+            "jax:pendulum", "--folder", str(folder),
+            "--num-envs", "8", "--total-steps", str(10**9),
+            "--set", *_SET_COMMON,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo,
+    )
+    try:
+        actor = subprocess.run(
+            [
+                sys.executable, "-m", "surreal_tpu", "actor",
+                "--folder", str(folder), "--episodes", "4",
+                "--num-envs", "2", "--fetch-every", "10",
+                # min-version 2 waits out the trainer's one-time second
+                # compile (iteration 1 publishes, then ~seconds of silence)
+                # so the actor's window overlaps a LIVE iterating learner
+                "--min-version", "2",
+                "--max-steps", "2000", "--wait", "240",
+            ],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert actor.returncode == 0, actor.stdout + actor.stderr
+        lines = [json.loads(ln) for ln in actor.stdout.splitlines()]
+        summary = lines[-1]
+        episodes = [ln for ln in lines if "episode" in ln]
+        assert episodes, actor.stdout
+        assert all(ep["param_version"] >= 1 for ep in episodes)
+        # the proof this tracked a LIVE learner, not a snapshot
+        assert summary["actor/versions_seen"] >= 2, summary
+        assert summary["actor/param_version"] >= 2
+
+        follow = subprocess.run(
+            [
+                sys.executable, "-m", "surreal_tpu", "eval",
+                "--folder", str(folder), "--follow", "--rounds", "2",
+                "--episodes", "2", "--wait", "120",
+            ],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert follow.returncode == 0, follow.stdout + follow.stderr
+        rounds = [json.loads(ln) for ln in follow.stdout.splitlines()]
+        assert len(rounds) == 2
+        for r in rounds:
+            assert "eval/return" in r and r["param_version"] >= 1
+        # round 2 re-fetched from a live learner: version must not regress
+        assert rounds[1]["param_version"] >= rounds[0]["param_version"]
+        # the trainer stayed alive through both consumers (a crashed
+        # trainer with a lingering server would invalidate the test)
+        assert trainer.poll() is None
+    finally:
+        trainer.kill()
+        trainer.communicate()
