@@ -74,6 +74,12 @@ class Agent:
 
         if fetch_every < 1:
             raise ValueError(f"fetch_every must be >= 1, got {fetch_every}")
+        if getattr(self.learner, "requires_act_carry", False):
+            raise ValueError(
+                "remote actors act statelessly per step; "
+                "model.encoder.kind='trajectory' policies run in the "
+                "fused device collectors"
+            )
         self.state = state
         self._client = ParameterClient(server_address, self.acting_view(state))
         self._fetch_every = fetch_every

@@ -75,9 +75,20 @@ class Evaluator:
                 # make the outcome frame (the lift, the thread)
                 # structurally unrecordable
                 self._jit_step1 = jax.jit(self.env.env.step)
-                self._jit_act1 = jax.jit(self.agent.act)
+                # act_step == act for memoryless policies; sequence
+                # policies thread their context carry through the episode
+                from functools import partial
+
+                self._jit_act1 = jax.jit(
+                    partial(self.agent.learner.act_step, mode=self.agent.mode)
+                )
         else:
             probe.close()
+            if getattr(learner, "requires_act_carry", False):
+                raise ValueError(
+                    "trajectory policies evaluate on device envs (jax:*): "
+                    "the host eval loop acts statelessly per step"
+                )
             self.env = make_env(
                 Config(num_envs=self.episodes).extend(env_config)
             )
@@ -95,10 +106,15 @@ class Evaluator:
             jax.random.split(reset_key, self.episodes)
         )
         B = self.episodes
+        learner = self.agent.learner
 
         def step(carry, k):
-            env_state, obs, ret, length, alive, success = carry
-            action, _ = self.agent.act(state, obs, k)
+            env_state, obs, ret, length, alive, success, act_carry = carry
+            # act_step == act for memoryless policies; sequence policies
+            # thread their context carry (re-segmenting past the horizon)
+            action, _, act_carry = learner.act_step(
+                state, act_carry, obs, k, self.agent.mode
+            )
             env_state, obs2, reward, done, info = jax.vmap(self.env.step)(
                 env_state, action
             )
@@ -107,7 +123,7 @@ class Evaluator:
             if "success" in info:
                 success = success | (info["success"] & (alive > 0))
             alive = alive * (1.0 - done.astype(jnp.float32))
-            return (env_state, obs2, ret, length, alive, success), None
+            return (env_state, obs2, ret, length, alive, success, act_carry), None
 
         init = (
             env_state,
@@ -116,8 +132,9 @@ class Evaluator:
             jnp.zeros(B, jnp.int32),
             jnp.ones(B, jnp.float32),
             jnp.zeros(B, bool),
+            learner.act_init(B),
         )
-        (_, _, ret, length, _, success), _ = jax.lax.scan(
+        (_, _, ret, length, _, success, _), _ = jax.lax.scan(
             step, init, jax.random.split(step_key, self._time_limit)
         )
         return {
@@ -170,9 +187,12 @@ class Evaluator:
         key, reset_key = jax.random.split(key)
         env_state, obs = self.env.env.reset(reset_key)  # raw env, no AutoReset
         frames = [render(env_state)]
+        act_carry = self.agent.learner.act_init(1)
         for _ in range(self._time_limit):
             key, akey = jax.random.split(key)
-            action, _ = self._jit_act1(state, obs[None], akey)
+            action, _, act_carry = self._jit_act1(
+                state, act_carry, obs[None], akey
+            )
             env_state, obs, reward, done, info = self._jit_step1(
                 env_state, action[0]
             )

@@ -68,6 +68,17 @@ def _to_host_local(tree):
     return jax.tree.map(np.asarray, tree)
 
 
+def _acting_refresh(act_base, state):
+    """Host-local acting snapshot: read ONLY params + obs_stats from the
+    replicated global ``state`` (a local read) and graft them onto the
+    device-resident ``act_base`` built at run start — optimizer moments
+    never cross the host boundary again (they'd triple the per-iteration
+    refresh bytes for leaves acting never reads)."""
+    params = jax.device_put(jax.tree.map(np.asarray, state.params))
+    stats = jax.device_put(jax.tree.map(np.asarray, state.obs_stats))
+    return act_base._replace(params=params, obs_stats=stats)
+
+
 class _MultiHostSession:
     """The multi-controller session discipline shared by every multi-host
     driver: rank bookkeeping, restore-and-broadcast, and the once-compiled
@@ -287,17 +298,20 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                 from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
 
                 recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
+                # full local copy ONCE (moments land on device and stay);
+                # per-iteration refreshes graft params + obs_stats only
+                act_base = jax.device_put(lazy_host_state())
                 while env_steps < total:
                     key, r_key, l_key, hk_key = jax.random.split(key, 4)
                     # act against a host-local param copy (the SEED host
                     # loop is per-process; only learn is global), with
-                    # per-rank exploration streams. device_put ONCE per
-                    # iteration: passing the numpy pytree straight into the
-                    # per-step jitted act would re-upload the full param
-                    # tree on every env step of the rollout
-                    act_state = jax.device_put(lazy_host_state())
+                    # per-rank exploration streams. One params+stats
+                    # upload per ITERATION: shipping the numpy pytree
+                    # straight into the per-step jitted act would re-pay
+                    # it every env step of the rollout
+                    act_base = _acting_refresh(act_base, state)
                     obs, batch, ep_stats = host_rollout(
-                        self.env, self._act, act_state, obs,
+                        self.env, self._act, act_base, obs,
                         jax.random.fold_in(r_key, self.rank), self.horizon,
                     )
                     gbatch = local_batch_to_global(self.mesh, batch, batch_dim=1)
@@ -503,13 +517,8 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
         ).extend(env_cfg)
 
     def _refresh_act_state(self, state):
-        """Host-local acting snapshot: read ONLY params + obs_stats from
-        the replicated global state (a local read) and graft them onto the
-        device-resident base built at run start — optimizer moments never
-        cross the host boundary."""
-        params = jax.device_put(jax.tree.map(np.asarray, state.params))
-        stats = jax.device_put(jax.tree.map(np.asarray, state.obs_stats))
-        self._act_base = self._act_base._replace(params=params, obs_stats=stats)
+        """Params+obs_stats-only acting refresh (see ``_acting_refresh``)."""
+        self._act_base = _acting_refresh(self._act_base, state)
         return self._act_base
 
     def run(

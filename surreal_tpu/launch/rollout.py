@@ -73,9 +73,11 @@ def device_rollout(
     """
 
     def step(scan_carry, step_key):
-        c: RolloutCarry = scan_carry
+        c, act_carry = scan_carry
         akey, skey = jax.random.split(step_key)
-        action, info = learner.act(state, c.obs, akey, TRAINING)
+        action, info, act_carry = learner.act_step(
+            state, act_carry, c.obs, akey, TRAINING
+        )
         env_state, obs2, reward, done, step_info = batch_step(
             env, c.env_state, action
         )
@@ -102,10 +104,15 @@ def device_rollout(
             ep_return=jnp.where(done, 0.0, ep_return),
             ep_length=jnp.where(done, 0, ep_length),
         )
-        return new_c, trans
+        return (new_c, act_carry), trans
 
     keys = jax.random.split(key, horizon)
-    new_carry, batch = jax.lax.scan(step, carry, keys)
+    # a FRESH act carry per rollout call: sequence policies' context is
+    # segment-aligned (learn recomputes exactly this conditioning);
+    # memoryless learners get None, which scans as an empty pytree
+    (new_carry, _), batch = jax.lax.scan(
+        step, (carry, learner.act_init(carry.obs.shape[0])), keys
+    )
     return new_carry, batch
 
 

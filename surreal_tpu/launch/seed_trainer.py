@@ -118,6 +118,13 @@ class SEEDTrainer:
         self.specs = probe.specs
         probe.close()
         self.learner = build_learner(config.learner_config, self.specs)
+        if getattr(self.learner, "requires_act_carry", False):
+            raise ValueError(
+                "model.encoder.kind='trajectory' is not supported by the "
+                "SEED inference server: its per-request batched forward "
+                "is stateless, and the sequence context carry lives in "
+                "the fused device collectors"
+            )
         self.algo = self.learner.config.algo
         self.num_workers = max(1, config.session_config.topology.num_env_workers)
         self.worker_mode = worker_mode

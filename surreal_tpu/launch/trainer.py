@@ -64,6 +64,12 @@ class Trainer:
             else:
                 self._train_iter = jax.jit(self._device_train_iter)
         else:
+            if getattr(self.learner, "requires_act_carry", False):
+                raise ValueError(
+                    "model.encoder.kind='trajectory' needs a device env "
+                    "(jax:*): host loops act per-step without the "
+                    "sequence context carry"
+                )
             self.mesh = None
             self._act = jax.jit(partial(self.learner.act, mode="training"))
             self._learn = jax.jit(self.learner.learn)
@@ -144,28 +150,123 @@ class Trainer:
                     if stop:
                         break
             else:
-                obs = self.env.reset(seed=self.config.env_config.seed)
-                from collections import deque
-
-                from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
-
-                recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
-                while env_steps < total:
-                    key, r_key, l_key, hk_key = jax.random.split(key, 4)
-                    obs, batch, ep_stats = host_rollout(
-                        self.env, self._act, state, obs, r_key, self.horizon
+                overlap = bool(
+                    self.config.session_config.topology.get(
+                        "overlap_rollouts", True
                     )
-                    state, metrics = self._learn(state, batch, l_key)
-                    iteration += 1
-                    env_steps += steps_per_iter
-                    recent_returns.extend(ep_stats["returns"])
-                    _, stop = hooks.end_iteration(
-                        iteration, env_steps, state, hk_key,
-                        host_metrics(metrics, recent_returns), on_metrics,
-                    )
-                    if stop:
-                        break
+                )
+                loop = self._host_loop_overlap if overlap else self._host_loop_alternate
+                state, iteration, env_steps = loop(
+                    state, iteration, env_steps, total, key, hooks, on_metrics
+                )
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:
             hooks.close()
+
+    # -- host-env loops ------------------------------------------------------
+    def _host_loop_alternate(
+        self, state, iteration, env_steps, total, key, hooks, on_metrics
+    ):
+        """Strict rollout -> learn alternation (topology.overlap_rollouts
+        = false): the chip idles during every env step, but policy lag is
+        exactly zero — the conservative/debugging mode."""
+        from collections import deque
+
+        from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
+
+        steps_per_iter = self.horizon * self.num_envs
+        obs = self.env.reset(seed=self.config.env_config.seed)
+        recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
+        while env_steps < total:
+            key, r_key, l_key, hk_key = jax.random.split(key, 4)
+            obs, batch, ep_stats = host_rollout(
+                self.env, self._act, state, obs, r_key, self.horizon
+            )
+            state, metrics = self._learn(state, batch, l_key)
+            iteration += 1
+            env_steps += steps_per_iter
+            recent_returns.extend(ep_stats["returns"])
+            _, stop = hooks.end_iteration(
+                iteration, env_steps, state, hk_key,
+                host_metrics(metrics, recent_returns), on_metrics,
+            )
+            if stop:
+                break
+        return state, iteration, env_steps
+
+    def _host_loop_overlap(
+        self, state, iteration, env_steps, total, key, hooks, on_metrics
+    ):
+        """Double-buffered host loop (SURVEY.md §3.4 — the reference's
+        learner never waited on actors; §7 hard-part #1): a collector
+        thread steps the env for iteration k+1 while the device learns on
+        k, so iteration wall-clock is ~max(rollout, learn) instead of
+        their sum. The collector reads the acting state ONCE per rollout
+        (a coherent behavior policy per batch, recorded in behavior_logp),
+        at most one update behind — exactly the staleness PPO's ratios /
+        V-trace are built to absorb. At the stop boundary one in-flight
+        rollout may be discarded; its env steps are not counted (same
+        budget discipline as the SEED drop path)."""
+        import queue as queue_mod
+        import threading
+        from collections import deque
+
+        from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
+
+        steps_per_iter = self.horizon * self.num_envs
+        key, roll_key = jax.random.split(key)
+        act_state = [state]  # collector reads latest; main thread writes
+        out: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+        stop_evt = threading.Event()
+
+        def collect():
+            obs = self.env.reset(seed=self.config.env_config.seed)
+            k = roll_key
+            try:
+                while not stop_evt.is_set():
+                    k, r_key = jax.random.split(k)
+                    obs, batch, ep_stats = host_rollout(
+                        self.env, self._act, act_state[0], obs, r_key,
+                        self.horizon,
+                    )
+                    item = (batch, ep_stats)
+                    while not stop_evt.is_set():
+                        try:
+                            out.put(item, timeout=0.2)
+                            break
+                        except queue_mod.Full:
+                            continue
+            except BaseException as e:  # surface env/act crashes to main
+                out.put(e)
+
+        collector = threading.Thread(target=collect, daemon=True)
+        collector.start()
+        recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
+        try:
+            while env_steps < total:
+                got = out.get()
+                if isinstance(got, BaseException):
+                    raise got
+                batch, ep_stats = got
+                key, l_key, hk_key = jax.random.split(key, 3)
+                state, metrics = self._learn(state, batch, l_key)
+                act_state[0] = state  # device-resident; no host copy
+                iteration += 1
+                env_steps += steps_per_iter
+                recent_returns.extend(ep_stats["returns"])
+                _, stop = hooks.end_iteration(
+                    iteration, env_steps, state, hk_key,
+                    host_metrics(metrics, recent_returns), on_metrics,
+                )
+                if stop:
+                    break
+        finally:
+            stop_evt.set()
+            while True:  # unblock a collector waiting on the full queue
+                try:
+                    out.get_nowait()
+                except queue_mod.Empty:
+                    break
+            collector.join(timeout=30)
+        return state, iteration, env_steps

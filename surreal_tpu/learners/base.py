@@ -40,6 +40,22 @@ class Learner(abc.ABC):
     def __init__(self, learner_config, env_specs: EnvSpecs):
         self.config = learner_config
         self.specs = env_specs
+        # fail-fast-on-unwired-knobs convention: the trajectory encoder is
+        # implemented by PPOLearner (which overrides this flag before it
+        # can raise); any other algorithm silently ignoring the knob would
+        # train a different model than the user configured
+        enc = learner_config.get("model", None)
+        enc = enc.get("encoder", None) if enc is not None else None
+        if (
+            enc is not None
+            and enc.get("kind", "auto") == "trajectory"
+            and not self.supports_trajectory_encoder
+        ):
+            raise ValueError(
+                "model.encoder.kind='trajectory' is a PPO-family seam "
+                f"(got algo {learner_config.algo.name!r}); ddpg/impala "
+                "use their own model builds"
+            )
 
     # -- state ---------------------------------------------------------------
     @abc.abstractmethod
@@ -63,6 +79,27 @@ class Learner(abc.ABC):
         learner needs attached to experience (behavior-policy stats — the
         reference's ``action_info``, SURVEY.md §2.1 PPO-agent row).
         """
+
+    # -- sequence/recurrent acting seam (SURVEY.md §5.7) ---------------------
+    # Policies that condition on history (trajectory transformers; a
+    # future RNN) thread a per-env acting carry through rollouts. The
+    # memoryless default keeps `act_step` == `act`, so every existing
+    # collector runs unchanged; drivers that cannot thread a carry (host
+    # SEED plane, remote actors) gate on `requires_act_carry`.
+    requires_act_carry: bool = False
+    supports_trajectory_encoder: bool = False  # PPOLearner implements it
+
+    def act_init(self, num_envs: int) -> Any:
+        """Fresh acting carry for a rollout segment (None = memoryless)."""
+        return None
+
+    def act_step(
+        self, state: Any, act_carry: Any, obs: jax.Array, key: jax.Array,
+        mode: str = TRAINING,
+    ):
+        """History-conditioned acting: (action, act_info, new_carry)."""
+        action, info = self.act(state, obs, key, mode)
+        return action, info, act_carry
 
     # -- bookkeeping ---------------------------------------------------------
     def default_config(self):  # override per algorithm

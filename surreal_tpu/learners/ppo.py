@@ -61,8 +61,19 @@ PPO_LEARNER_CONFIG = Config(
         clip_value=True,      # PPO-style value clipping
         norm_adv=True,
         init_log_std=-0.5,
-        gae_impl="xla",       # 'xla' (lax.scan) | 'pallas' (ops/pallas_gae
-                              # fused kernel; interpret mode off-TPU)
+        gae_impl="xla",       # 'xla' (lax.scan) | 'assoc' (log-depth
+                              # associative_scan — ~T/log2(T) fewer
+                              # sequential steps, the right pick on
+                              # latency-bound backends) | 'pallas'
+                              # (ops/pallas_gae fused kernel; interpret
+                              # mode off-TPU)
+        # value forward for GAE: 'exact' runs a second model.apply over
+        # next_obs so truncated episodes bootstrap off the TRUE pre-reset
+        # terminal obs; 'shared' reuses one apply over [obs; last
+        # next_obs] (shifted values) — half the GAE forward work, at the
+        # cost of bootstrapping truncation boundaries off the post-reset
+        # obs (terminations are exact either way: their discount is 0)
+        value_bootstrap="exact",
     ),
     replay=Config(kind="fifo"),
 )
@@ -77,11 +88,24 @@ class PPOState(NamedTuple):
 
 
 class PPOLearner(Learner):
+    supports_trajectory_encoder = True
+
     def __init__(self, learner_config, env_specs: EnvSpecs):
         super().__init__(learner_config, env_specs)
         algo = learner_config.algo
         self.discrete = env_specs.discrete
-        if self.discrete:
+        enc = learner_config.model.get("encoder", None)
+        self.seq_policy = bool(enc is not None and enc.get("kind") == "trajectory")
+        self.requires_act_carry = self.seq_policy
+        if self.seq_policy:
+            if learner_config.model.cnn.enabled:
+                raise ValueError(
+                    "model.encoder.kind='trajectory' takes flat vector obs; "
+                    "combine it with pixel envs via a CNN feature env "
+                    "wrapper, not model.cnn.enabled"
+                )
+            self.model = self._build_seq_model(mesh=None)
+        elif self.discrete:
             self.model = CategoricalPPOModel(
                 model_cfg=learner_config.model.to_dict(),
                 n_actions=env_specs.action.n,
@@ -94,6 +118,33 @@ class PPOLearner(Learner):
                 init_log_std=algo.init_log_std,
             )
         self.tx = self._make_optimizer(learner_config.optimizer)
+
+    def _build_seq_model(self, mesh, sp_axis: str = "sp"):
+        from surreal_tpu.models.attention import (
+            TrajectoryCategoricalPPOModel,
+            TrajectoryPPOModel,
+        )
+
+        enc_cfg = self.config.model.encoder.to_dict()
+        if self.discrete:
+            return TrajectoryCategoricalPPOModel(
+                encoder_cfg=enc_cfg, n_actions=self.specs.action.n,
+                mesh=mesh, sp_axis=sp_axis,
+            )
+        return TrajectoryPPOModel(
+            encoder_cfg=enc_cfg,
+            act_dim=int(self.specs.action.shape[0]),
+            init_log_std=self.config.algo.init_log_std,
+            mesh=mesh, sp_axis=sp_axis,
+        )
+
+    def rebind_mesh(self, mesh, sp_axis: str = "sp") -> None:
+        """Route the trajectory encoder's attention through the ring over
+        ``mesh[sp_axis]`` (ops/ring_attention.py) — params are unchanged
+        (same module tree, different attention schedule), so this is safe
+        after ``init``/restore. No-op for memoryless policies."""
+        if self.seq_policy:
+            self.model = self._build_seq_model(mesh=mesh, sp_axis=sp_axis)
 
     def _make_optimizer(self, opt_cfg) -> optax.GradientTransformation:
         if opt_cfg.lr_schedule == "linear":
@@ -109,7 +160,10 @@ class PPOLearner(Learner):
 
     # -- state ---------------------------------------------------------------
     def init(self, key: jax.Array) -> PPOState:
-        obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
+        if self.seq_policy:
+            obs = jnp.zeros((1, 1, *self.specs.obs.shape), self.specs.obs.dtype)
+        else:
+            obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
         params = self.model.init(key, obs)
         return PPOState(
             params=params,
@@ -137,10 +191,9 @@ class PPOLearner(Learner):
         return normalize(stats, obs.astype(jnp.float32))
 
     # -- acting --------------------------------------------------------------
-    def act(self, state: PPOState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
-        out = self.model.apply(
-            state.params, self._norm_obs(state.obs_stats, obs)
-        )
+    def _head_act(self, out, key: jax.Array, mode: str):
+        """Sample/argmax + behavior info from head outputs (shared by the
+        memoryless ``act`` and the sequence ``act_step``)."""
         if self.discrete:
             if mode == EVAL_DETERMINISTIC:
                 action = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
@@ -162,6 +215,62 @@ class PPOLearner(Learner):
             }
         return action, info
 
+    def act(self, state: PPOState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
+        if self.seq_policy:
+            raise RuntimeError(
+                "trajectory policies condition on history: act through "
+                "act_init/act_step (the device collectors and evaluator "
+                "do); host SEED planes and remote actors do not support "
+                "model.encoder.kind='trajectory'"
+            )
+        out = self.model.apply(
+            state.params, self._norm_obs(state.obs_stats, obs)
+        )
+        return self._head_act(out, key, mode)
+
+    # -- sequence acting (model.encoder.kind='trajectory') -------------------
+    def act_init(self, num_envs: int):
+        """Segment context: a zero obs buffer of horizon length plus the
+        write position. Collectors call this at each rollout start, so the
+        policy's context resets on segment boundaries — exactly the
+        conditioning ``_learn_seq`` recomputes (the PPO ratio contract)."""
+        if not self.seq_policy:
+            return None
+        T = int(self.config.algo.horizon)
+        return {
+            "buf": jnp.zeros((num_envs, T, *self.specs.obs.shape), jnp.float32),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+
+    def act_step(self, state, act_carry, obs, key, mode=TRAINING):
+        """Deliberate simplicity tradeoff: each step re-runs the full
+        padded [B, T] segment forward and reads one position — O(T^2)
+        attention per rollout vs a KV-cached incremental step, but ONE
+        compiled program whose per-position outputs match ``_learn_seq``
+        bit-for-bit in structure. The KV-cache is the optimization seam
+        when long-horizon acting cost shows up in profiles."""
+        if not self.seq_policy:
+            return super().act_step(state, act_carry, obs, key, mode)
+        buf, pos = act_carry["buf"], act_carry["pos"]
+        T = buf.shape[1]
+        # long eval episodes outrun one segment: re-segment (fresh
+        # context), matching how training segments the stream
+        wrap = pos >= T
+        buf = jnp.where(wrap, jnp.zeros_like(buf), buf)
+        pos = jnp.where(wrap, 0, pos)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, obs.astype(jnp.float32)[:, None], pos, axis=1
+        )
+        # causal attention: position `pos` sees only the 0..pos prefix —
+        # the zero padding at future positions is unread by construction
+        out = self.model.apply(
+            state.params, self._norm_obs(state.obs_stats, buf)
+        )
+        at = lambda x: jax.lax.dynamic_index_in_dim(x, pos, axis=1, keepdims=False)
+        out_t = jax.tree.map(at, out)
+        action, info = self._head_act(out_t, key, mode)
+        return action, info, {"buf": buf, "pos": pos + 1}
+
     # -- learning ------------------------------------------------------------
     def learn(self, state: PPOState, batch: dict, key: jax.Array, axis_name=None):
         """One SGD iteration. When ``axis_name`` is set (running inside
@@ -172,6 +281,8 @@ class PPOLearner(Learner):
         from surreal_tpu.utils.asserts import check_learn_batch
 
         check_learn_batch(batch, self.specs, name="ppo.learn")
+        if self.seq_policy:
+            return self._learn_seq(state, batch, key, axis_name)
         algo = self.config.algo
         T, B = batch["reward"].shape
 
@@ -185,52 +296,17 @@ class PPOLearner(Learner):
         obs = self._norm_obs(obs_stats, batch["obs"])
         next_obs = self._norm_obs(obs_stats, batch["next_obs"])
 
-        # 2) GAE with exact truncation handling
-        out_t = self.model.apply(state.params, obs)
-        v_next = self.model.apply(state.params, next_obs).value
-        values = out_t.value
-        gamma = jnp.asarray(algo.gamma, jnp.float32)
-        boot_disc = gamma * (1.0 - batch["terminated"].astype(jnp.float32))
-        lam_disc_mask = 1.0 - batch["done"].astype(jnp.float32)
-        deltas_disc = boot_disc
-        # (ops.returns.gae_advantages expects a [T+1] value stack; the
-        # truncation-exact form here needs distinct bootstrap/decay masks)
-        decay = gamma * algo.lam * lam_disc_mask
-        if algo.get("gae_impl", "xla") == "pallas":
-            from surreal_tpu.ops.pallas_gae import gae_advantages_pallas_masked
-
-            advantages, value_targets = gae_advantages_pallas_masked(
-                batch["reward"],
-                deltas_disc,
-                decay,
-                values,
-                v_next,
-                interpret=jax.default_backend() != "tpu",
-            )
+        # 2) value forward for GAE (one shared pass, or the exact two-pass
+        # form — see PPO_LEARNER_CONFIG value_bootstrap)
+        if algo.get("value_bootstrap", "exact") == "shared":
+            stack = jnp.concatenate([obs, next_obs[-1:]], axis=0)
+            v_all = self.model.apply(state.params, stack).value
+            values, v_next = v_all[:-1], v_all[1:]
         else:
-            deltas = batch["reward"] + deltas_disc * v_next - values
-
-            def gae_step(carry, xs):
-                delta_t, decay_t = xs
-                adv = delta_t + decay_t * carry
-                return adv, adv
-
-            _, advs_rev = jax.lax.scan(
-                gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1])
-            )
-            advantages = advs_rev[::-1]
-            value_targets = advantages + values
-
-        if algo.norm_adv:
-            if axis_name is None:
-                adv_mean = advantages.mean()
-                adv_var = advantages.var()
-            else:
-                adv_mean = jax.lax.pmean(advantages.mean(), axis_name)
-                adv_var = (
-                    jax.lax.pmean((advantages**2).mean(), axis_name) - adv_mean**2
-                )
-            advantages = (advantages - adv_mean) / (jnp.sqrt(adv_var) + 1e-8)
+            values = self.model.apply(state.params, obs).value
+            v_next = self.model.apply(state.params, next_obs).value
+        advantages, value_targets = self._gae(batch, values, v_next)
+        advantages = self._norm_advantages(advantages, axis_name)
 
         # 3) flatten time x batch -> sample axis
         N = T * B
@@ -248,56 +324,124 @@ class PPOLearner(Learner):
             flat["b_mean"] = batch["behavior"]["mean"].reshape(N, -1)
             flat["b_log_std"] = batch["behavior"]["log_std"].reshape(N, -1)
 
-        num_mb = algo.num_minibatches
-        mb_size = N // num_mb
+        sgd_out = self._sgd_epochs(
+            state, flat, N, algo.num_minibatches, key, axis_name
+        )
+        return self._finalize(
+            state, obs_stats, sgd_out, values, value_targets, advantages,
+            axis_name,
+        )
 
-        def loss_fn(params, mb, kl_beta, policy_coeff):
-            out = self.model.apply(params, mb["obs"])
-            if self.discrete:
-                logp = D.categorical_logp(out.logits, mb["action"])
-                kl = D.categorical_kl(mb["b_logits"], out.logits).mean()
-                entropy = D.categorical_entropy(out.logits).mean()
-            else:
-                logp = D.diag_gauss_logp(out.mean, out.log_std, mb["action"])
-                kl = D.diag_gauss_kl(
-                    mb["b_mean"], mb["b_log_std"], out.mean, out.log_std
-                ).mean()
-                entropy = D.diag_gauss_entropy(out.log_std).mean()
+    # -- pieces shared by the memoryless and sequence learn paths ------------
+    def _gae(self, batch, values, v_next):
+        """GAE over [T, B] arrays with the truncation-exact two-mask form
+        (bootstrap discount gamma*(1-terminated) vs accumulation decay
+        gamma*lam*(1-done)), routed by ``algo.gae_impl``: 'xla' lax.scan,
+        'assoc' log-depth associative_scan (~log2(T) combine rounds — the
+        dispatch-latency pick), or the fused 'pallas' kernel."""
+        algo = self.config.algo
+        gamma = jnp.asarray(algo.gamma, jnp.float32)
+        boot_disc = gamma * (1.0 - batch["terminated"].astype(jnp.float32))
+        decay = gamma * algo.lam * (1.0 - batch["done"].astype(jnp.float32))
+        gae_impl = algo.get("gae_impl", "xla")
+        if gae_impl == "pallas":
+            from surreal_tpu.ops.pallas_gae import gae_advantages_pallas_masked
 
-            ratio = jnp.exp(logp - mb["behavior_logp"])
-            if algo.ppo_mode == "clip":
-                clipped = jnp.clip(ratio, 1.0 - algo.clip_ratio, 1.0 + algo.clip_ratio)
-                pg_loss = -jnp.minimum(ratio * mb["adv"], clipped * mb["adv"]).mean()
-            else:  # adaptive KL penalty
-                pg_loss = -(ratio * mb["adv"]).mean() + kl_beta * kl
-
-            v = out.value
-            if algo.clip_value:
-                v_clip = mb["value_old"] + jnp.clip(
-                    v - mb["value_old"], -algo.clip_ratio, algo.clip_ratio
-                )
-                v_loss = 0.5 * jnp.maximum(
-                    (v - mb["target"]) ** 2, (v_clip - mb["target"]) ** 2
-                ).mean()
-            else:
-                v_loss = 0.5 * ((v - mb["target"]) ** 2).mean()
-
-            total = (
-                policy_coeff * (pg_loss - algo.entropy_coeff * entropy)
-                + algo.value_coeff * v_loss
+            return gae_advantages_pallas_masked(
+                batch["reward"], boot_disc, decay, values, v_next,
+                interpret=jax.default_backend() != "tpu",
             )
-            return total, {
-                "pg_loss": pg_loss,
-                "v_loss": v_loss,
-                "entropy": entropy,
-                "kl": kl,
-            }
+        deltas = batch["reward"] + boot_disc * v_next - values
+        if gae_impl == "assoc":
+            from surreal_tpu.ops.returns import reverse_linear_scan_assoc
 
-        grad_fn = jax.grad(loss_fn, has_aux=True)
+            advantages = reverse_linear_scan_assoc(decay, deltas)
+            return advantages, advantages + values
+        if gae_impl != "xla":
+            raise ValueError(f"gae_impl {gae_impl!r} not in xla|assoc|pallas")
 
-        def mb_update(carry, mb_idx_perm):
+        def gae_step(carry, xs):
+            delta_t, decay_t = xs
+            adv = delta_t + decay_t * carry
+            return adv, adv
+
+        _, advs_rev = jax.lax.scan(
+            gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1])
+        )
+        advantages = advs_rev[::-1]
+        return advantages, advantages + values
+
+    def _norm_advantages(self, advantages, axis_name):
+        if not self.config.algo.norm_adv:
+            return advantages
+        if axis_name is None:
+            adv_mean, adv_var = advantages.mean(), advantages.var()
+        else:
+            adv_mean = jax.lax.pmean(advantages.mean(), axis_name)
+            adv_var = (
+                jax.lax.pmean((advantages**2).mean(), axis_name) - adv_mean**2
+            )
+        return (advantages - adv_mean) / (jnp.sqrt(adv_var) + 1e-8)
+
+    def _loss_fn(self, params, mb, kl_beta, policy_coeff):
+        """Clipped / adaptive-KL PPO loss. Every reduction is a
+        full-tensor mean, so flat [N] minibatches (memoryless path) and
+        [envs, T] segment minibatches (sequence path) share it verbatim."""
+        algo = self.config.algo
+        out = self.model.apply(params, mb["obs"])
+        if self.discrete:
+            logp = D.categorical_logp(out.logits, mb["action"])
+            kl = D.categorical_kl(mb["b_logits"], out.logits).mean()
+            entropy = D.categorical_entropy(out.logits).mean()
+        else:
+            logp = D.diag_gauss_logp(out.mean, out.log_std, mb["action"])
+            kl = D.diag_gauss_kl(
+                mb["b_mean"], mb["b_log_std"], out.mean, out.log_std
+            ).mean()
+            entropy = D.diag_gauss_entropy(out.log_std).mean()
+
+        ratio = jnp.exp(logp - mb["behavior_logp"])
+        if algo.ppo_mode == "clip":
+            clipped = jnp.clip(ratio, 1.0 - algo.clip_ratio, 1.0 + algo.clip_ratio)
+            pg_loss = -jnp.minimum(ratio * mb["adv"], clipped * mb["adv"]).mean()
+        else:  # adaptive KL penalty
+            pg_loss = -(ratio * mb["adv"]).mean() + kl_beta * kl
+
+        v = out.value
+        if algo.clip_value:
+            v_clip = mb["value_old"] + jnp.clip(
+                v - mb["value_old"], -algo.clip_ratio, algo.clip_ratio
+            )
+            v_loss = 0.5 * jnp.maximum(
+                (v - mb["target"]) ** 2, (v_clip - mb["target"]) ** 2
+            ).mean()
+        else:
+            v_loss = 0.5 * ((v - mb["target"]) ** 2).mean()
+
+        total = (
+            policy_coeff * (pg_loss - algo.entropy_coeff * entropy)
+            + algo.value_coeff * v_loss
+        )
+        return total, {
+            "pg_loss": pg_loss,
+            "v_loss": v_loss,
+            "entropy": entropy,
+            "kl": kl,
+        }
+
+    def _sgd_epochs(self, state, data, domain, num_mb, key, axis_name):
+        """epochs x minibatches as one nested lax.scan with KL early-stop.
+        ``data`` is any pytree indexed on its leading axis of size
+        ``domain`` — flat (t, b) samples in the memoryless path, whole-env
+        segments in the sequence path; the gather is the ONLY difference
+        between the two training loops."""
+        algo = self.config.algo
+        mb_size = domain // num_mb
+        grad_fn = jax.grad(self._loss_fn, has_aux=True)
+
+        def mb_update(carry, mb_idx):
             params, opt_state, stopped = carry
-            mb = jax.tree.map(lambda x: x[mb_idx_perm], flat)
+            mb = jax.tree.map(lambda x: x[mb_idx], data)
             policy_coeff = jnp.where(stopped, 0.0, 1.0)
             grads, aux = grad_fn(params, mb, state.kl_beta, policy_coeff)
             if axis_name is not None:
@@ -311,18 +455,28 @@ class PPOLearner(Learner):
             return (params, opt_state, stopped), aux
 
         def epoch_update(carry, epoch_key):
-            perm = jax.random.permutation(epoch_key, N)[: num_mb * mb_size]
-            perms = perm.reshape(num_mb, mb_size)
-            carry, auxs = jax.lax.scan(mb_update, carry, perms)
+            perm = jax.random.permutation(epoch_key, domain)[: num_mb * mb_size]
+            carry, auxs = jax.lax.scan(
+                mb_update, carry, perm.reshape(num_mb, mb_size)
+            )
             return carry, auxs
 
         epoch_keys = jax.random.split(key, algo.epochs)
-        (params, opt_state, stopped), auxs = jax.lax.scan(
-            epoch_update, (state.params, state.opt_state, jnp.asarray(False)), epoch_keys
+        return jax.lax.scan(
+            epoch_update,
+            (state.params, state.opt_state, jnp.asarray(False)),
+            epoch_keys,
         )
+
+    def _finalize(
+        self, state, obs_stats, sgd_out, values, value_targets, advantages,
+        axis_name,
+    ):
+        """Beta adaptation + new state + the shared metrics dict."""
+        algo = self.config.algo
+        (params, opt_state, stopped), auxs = sgd_out
         final_kl = auxs["kl"][-1, -1]
 
-        # 4) adaptive-KL beta update (reference's beta adaptation)
         beta = state.kl_beta
         if algo.ppo_mode == "adapt":
             lo, hi = algo.beta_range
@@ -360,3 +514,77 @@ class PPOLearner(Learner):
             # the replicated out-spec is truthful
             metrics = jax.lax.pmean(metrics, axis_name)
         return new_state, metrics
+
+    # -- sequence learning ---------------------------------------------------
+    def _learn_seq(self, state: PPOState, batch: dict, key: jax.Array, axis_name=None):
+        """One SGD iteration for the trajectory policy. Differences from
+        the memoryless path, all forced by history conditioning:
+
+        - the model applies over WHOLE segments [B, T, obs]; per-position
+          outputs reproduce exactly what ``act_step`` computed during the
+          rollout (same prefix, same padding) — the PPO ratio contract;
+        - minibatches are drawn over ENVS, never flat (t, b) samples — a
+          shuffled sample has no prefix to condition on (the LSTM-PPO
+          discipline, applied to attention);
+        - the GAE bootstrap at position T-1 comes from one extended
+          [B, T+1] pass (the final next_obs appended). At mid-segment
+          TRUNCATIONS the bootstrap conditions on the post-reset obs
+          rather than the pre-reset terminal obs: under sequence
+          conditioning the terminal obs has no well-defined standalone
+          context, and terminated steps (discount 0) are exact either
+          way. Documented bias, zero for untruncated segments.
+        """
+        algo = self.config.algo
+        T, B = batch["reward"].shape
+
+        if self._use_obs_filter:
+            obs_stats = update_stats(
+                state.obs_stats, batch["obs"], axis_name=axis_name
+            )
+        else:
+            obs_stats = state.obs_stats
+        # [T, B, ...] -> [B, T, ...]: the encoder is batch-major
+        obs_bt = jnp.swapaxes(
+            self._norm_obs(obs_stats, batch["obs"].astype(jnp.float32)), 0, 1
+        )
+        last_next = self._norm_obs(
+            obs_stats, batch["next_obs"][-1].astype(jnp.float32)
+        )
+        ext = jnp.concatenate([obs_bt, last_next[:, None]], axis=1)
+        out_ext = self.model.apply(state.params, ext)   # [B, T+1, ...]
+        values = out_ext.value[:, :T].swapaxes(0, 1)    # [T, B]
+        v_next = out_ext.value[:, 1:].swapaxes(0, 1)    # [T, B]
+
+        advantages, value_targets = self._gae(batch, values, v_next)
+        advantages = self._norm_advantages(advantages, axis_name)
+
+        # env-major training arrays [B, T, ...]; minibatches gather WHOLE
+        # envs, so _loss_fn recomputes full-segment conditioning
+        bt = lambda x: jnp.swapaxes(x, 0, 1)
+        data = {
+            "obs": obs_bt,
+            "action": bt(batch["action"]),
+            "behavior_logp": bt(batch["behavior_logp"]),
+            "adv": bt(advantages),
+            "target": bt(value_targets),
+            "value_old": bt(values),
+        }
+        if self.discrete:
+            data["b_logits"] = bt(batch["behavior"]["logits"])
+        else:
+            data["b_mean"] = bt(batch["behavior"]["mean"])
+            data["b_log_std"] = bt(batch["behavior"]["log_std"])
+
+        algo = self.config.algo
+        if B // algo.num_minibatches == 0:
+            raise ValueError(
+                f"num_minibatches={algo.num_minibatches} exceeds the env "
+                f"batch width {B}: sequence minibatches are whole envs"
+            )
+        sgd_out = self._sgd_epochs(
+            state, data, B, algo.num_minibatches, key, axis_name
+        )
+        return self._finalize(
+            state, obs_stats, sgd_out, values, value_targets, advantages,
+            axis_name,
+        )

@@ -29,6 +29,20 @@ BASE_LEARNER_CONFIG = Config(
         actor_hidden=(64, 64),
         critic_hidden=(64, 64),
         activation="tanh",
+        encoder=Config(
+            # policy/critic trunk family: 'auto' = CNN stem when
+            # model.cnn.enabled else MLP (the reference's two shapes);
+            # 'trajectory' = causal trajectory transformer
+            # (models/attention.py) whose attention rides ring attention
+            # over an `sp` mesh axis when one is bound — the long-context
+            # seam as a config knob (PPO-family, device envs; other
+            # learners fail fast rather than silently ignore it)
+            kind="auto",
+            features=64,
+            num_layers=2,
+            num_heads=4,
+            head_dim=16,
+        ),
         cnn=Config(
             enabled=False,          # pixel observations -> Nature-CNN stem
             channels=(32, 64, 64),
@@ -87,6 +101,13 @@ BASE_SESSION_CONFIG = Config(
         # spawn ctx — MuJoCo-heavy stepping holds the GIL, so real
         # deployments fork like the reference's actor pool did)
         worker_mode="thread",
+        # host-env (gym/dm_control) loops: collect iteration k+1 on a
+        # worker thread while the device learns on k (the reference's
+        # learner never waited on actors — its prefetch thread kept
+        # batches queued, SURVEY.md §3.4). Costs one update of policy
+        # staleness, which PPO ratios / V-trace absorb; false restores
+        # strict rollout->learn alternation.
+        overlap_rollouts=True,
         multihost=Config(          # multi-controller scaling (parallel/multihost.py)
             coordinator=None,      # "host:port" of process 0 ($JAX_COORDINATOR_ADDRESS)
             num_processes=None,    # total hosts/processes ($JAX_NUM_PROCESSES); None/1 = single

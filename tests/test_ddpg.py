@@ -280,6 +280,7 @@ def test_offpolicy_host_mode_nstep_end_to_end():
     assert metrics["time/env_steps"] >= 8 * 4 * 5
 
 
+@pytest.mark.slow
 def test_offpolicy_replay_checkpoint_resume_skips_warmup(tmp_path):
     """checkpoint.include_replay (beyond-parity opt-in; the reference did
     NOT checkpoint replay, SURVEY §5.4): a resumed run must reload the

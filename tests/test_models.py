@@ -91,6 +91,7 @@ def test_ppo_model_works_under_vmap_scan():
     assert values.shape == (3, 4)
 
 
+@pytest.mark.slow
 def test_trajectory_encoder_sp_matches_single_device():
     """The sequence-parallel seam is transparent: TrajectoryEncoder with a
     4-way sp mesh (ring attention, T sharded) must produce the same output

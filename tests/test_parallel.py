@@ -131,6 +131,7 @@ def test_dp_trainer_cartpole_iter_runs():
     assert metrics and np.isfinite(metrics["loss/value"])
 
 
+@pytest.mark.slow
 def test_dp_offpolicy_ddpg_prioritized_sharded_replay():
     """Multi-device DDPG (VERDICT round-1 item 6): per-device replay
     shards, pmean'd grads, pmax'd max-priority — state must stay replicated
@@ -175,6 +176,7 @@ def test_dp_offpolicy_ddpg_prioritized_sharded_replay():
     assert all(np.array_equal(shards[0], s) for s in shards[1:])
 
 
+@pytest.mark.slow
 def test_dp_offpolicy_matches_global_replay_semantics():
     """The dp-scaled shards must add up to the configured global buffer:
     inserting H*B windows per iter fills each of the 8 shards with the

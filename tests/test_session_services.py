@@ -163,6 +163,7 @@ def _trainer_cfg(folder, total_steps, **session_overrides):
     ).extend(base_config())
 
 
+@pytest.mark.slow
 def test_trainer_kill_and_resume_continues_curve(tmp_path):
     from surreal_tpu.launch.trainer import Trainer
 
@@ -195,6 +196,7 @@ def test_trainer_kill_and_resume_continues_curve(tmp_path):
     assert 20 in ckpt_steps
 
 
+@pytest.mark.slow
 def test_trainer_restore_from_foreign_folder(tmp_path):
     from surreal_tpu.launch.trainer import Trainer
 
@@ -214,6 +216,7 @@ def test_trainer_restore_from_foreign_folder(tmp_path):
 
 # -- launcher/CLI -----------------------------------------------------------
 
+@pytest.mark.slow
 def test_cli_train_then_eval_roundtrip(tmp_path):
     from surreal_tpu.main.launch import main
 
@@ -287,6 +290,7 @@ def test_evaluator_records_video(tmp_path):
         ev.close()
 
 
+@pytest.mark.slow
 def test_profiler_trace_window_writes_profile(tmp_path):
     """SURVEY §5.1: the session-config profiler hook must capture a
     jax.profiler trace window around the configured iterations and leave
