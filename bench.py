@@ -17,8 +17,13 @@ trustworthy fence is ``jax.device_get`` of a program OUTPUT — verified by
 linearity in iteration count and by FLOP sanity (the old numbers implied
 >100% MXU utilization on CNN workloads, a physical impossibility). This
 bench times a CHAINED loop (each iteration consumes the previous state)
-fenced by ``device_get``. Honest throughput on one v5lite chip is
-~3M env steps/s — ~30x the 100k north-star, not the fantasy 29,000x.
+fenced by ``device_get``. Honest throughput on one v5lite chip is ~34M
+env steps/s — ~340x the 100k north-star, measured with the same
+device_get fence, linearity check, and FLOP-sanity discipline as the
+round-3 correction. (Round 3 recorded ~3.5M; round 4's attribution found
+~70% of the learn phase was minibatch row-gather/permutation cost and
+replaced it with block-shuffled minibatching — learners/ppo.py
+``_sgd_epochs``, PERF.md.)
 
 The workload is latency-bound on the env scan (hundreds of sequential
 tiny elementwise ops per step), not matmul-bound: MFU is reported for
@@ -37,14 +42,15 @@ import time
 
 import jax
 
-# Throughput-optimal batch geometry from the round-3 HONEST sweep
-# (device_get-fenced, one v5lite chip): 512x128 1.68M, 1024x128 2.85M,
-# 2048x128 3.16M (knee), 4096x128 2.98M, 8192x128 2.55M steps/s.
-# Width beyond ~2048 costs linearly (elementwise env ops saturate), and
-# horizon costs linearly always (sequential scan), so the knee is the
-# widest batch that still amortizes per-iteration overhead.
-NUM_ENVS = 2048
-HORIZON = 128
+# Throughput-optimal batch geometry from the round-4 sweep
+# (device_get-fenced, one v5lite chip, block-shuffled minibatches —
+# round 4 found the old learn phase was ~70% row-gather/permutation
+# cost and removed it, moving the knee to a much larger batch):
+# 2048x256 24.3M, 4096x128 27.0M, 4096x256 34-38M (knee), 8192x128
+# 33.8M, 8192x256 32.4M, 16384x256 30.4M, 8192x512 29.8M steps/s.
+# (Round-3 knee for comparison: 2048x128 at 3.2-3.5M with row shuffling.)
+NUM_ENVS = 4096
+HORIZON = 256
 WARMUP_ITERS = 2
 MEASURE_ITERS = 10
 NORTH_STAR = 100_000.0

@@ -146,11 +146,14 @@ def ppo_lift_headline() -> dict:
     _, state_w = _timeit_chained(learn_step, state, key, iters=2)  # throwaway
     dt_learn, _ = _timeit_chained(learn_step, state_w, key)
 
+    attrib = _learn_attribution(trainer, state, learn_batch, key)
+
     # NOTE: no jax.profiler.trace here — on the axon backend a trace
     # window poisons every program compiled AFTER it (observed 500-1000x
     # slowdowns on post-trace compilations); the report's trace runs LAST
     # in main(), after all measurements.
     out = {
+        "attrib": attrib,
         "workload": "PPO+MLP jax:lift (BASELINE ③/north-star class)",
         "geometry": f"{num_envs} envs x {horizon} horizon, 4 epochs x 4 minibatches",
         "env_steps_per_s": sps,
@@ -163,6 +166,121 @@ def ppo_lift_headline() -> dict:
         out["flops_per_iter"] = flops
         out["model_flops_per_s"] = flops * ITERS / dt
         out["mfu"] = out["model_flops_per_s"] / PEAK_FLOPS_BF16
+    return out
+
+
+def _learn_attribution(trainer, state, learn_batch, key) -> dict:
+    """Where the learn phase's milliseconds go (round-4 VERDICT weak #1).
+
+    Sub-programs compiled and timed separately at the headline geometry.
+    The round-4 finding this documents: with row shuffling (the
+    reference's per-epoch reshuffle semantics), ~70% of learn time was
+    the per-epoch 1M-element argsort permutation + random row gathers
+    (4-byte-row leaves walk the TPU scalar unit); ALL sixteen grad steps
+    cost ~20 ms. algo.shuffle='block' (now the default) permutes
+    contiguous blocks instead and collapses the learn phase ~17x.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    learner = trainer.learner
+    out = {}
+
+    # learn-only under the reference-semantics row shuffle (the A/B)
+    from surreal_tpu.learners import build_learner
+    from surreal_tpu.session.config import Config
+
+    row_learner = build_learner(
+        Config(algo=Config(shuffle="row")).extend(trainer.learner.config),
+        trainer.env.specs,
+    )
+    learn_row = jax.jit(row_learner.learn)
+    key, k0 = jax.random.split(key)
+    s0, m0 = learn_row(state, learn_batch, k0)
+    jax.device_get(m0["loss/pg"])
+
+    def row_step(s, k):
+        s2, m = learn_row(s, learn_batch, k)
+        return s2, m["loss/pg"]
+
+    _, sw = _timeit_chained(row_step, state, key, iters=2)
+    dt_row, _ = _timeit_chained(row_step, sw, key)
+    out["learn_row_ms"] = dt_row / ITERS * 1e3
+
+    # sub-programs (block learner), each chained + device_get-fenced
+    obs_n = learner._norm_obs(state.obs_stats, learn_batch["obs"])
+    values = learner.model.apply(state.params, obs_n).value
+    v_next = learner.model.apply(
+        state.params, learner._norm_obs(state.obs_stats, learn_batch["next_obs"])
+    ).value
+    jax.device_get(values[-1, -1])
+
+    # value forwards (the two applies)
+    vf = jax.jit(
+        lambda s, c: learner.model.apply(
+            s.params, learner._norm_obs(s.obs_stats, learn_batch["obs"]) + c
+        ).value
+        + learner.model.apply(
+            s.params, learner._norm_obs(s.obs_stats, learn_batch["next_obs"])
+        ).value
+    )
+    jax.device_get(vf(state, jnp.float32(0))[-1, -1])
+
+    def vf_step(c, k):
+        v = vf(state, c)
+        # the carry MUST consume the output (the chaining contract): a
+        # carry independent of v would let the backend overlap launches
+        return v[-1, -1] * 0.0, v[-1, -1]
+
+    _timeit_chained(vf_step, jnp.float32(0), key, iters=2)
+    dt_vf, _ = _timeit_chained(vf_step, jnp.float32(0), key)
+    out["value_forwards_ms"] = dt_vf / ITERS * 1e3
+
+    # GAE alone
+    gb = {k_: learn_batch[k_] for k_ in ("reward", "done", "terminated")}
+    g = jax.jit(lambda c: learner._gae(gb, values + c, v_next)[0])
+    jax.device_get(g(jnp.float32(0))[-1, -1])
+
+    def g_step(c, k):
+        a = g(c)
+        return a[-1, -1] * 0.0, a[-1, -1]  # carry consumes the output
+
+    _timeit_chained(g_step, jnp.float32(0), key, iters=2)
+    dt_g, _ = _timeit_chained(g_step, jnp.float32(0), key)
+    out["gae_ms"] = dt_g / ITERS * 1e3
+
+    # grad steps with NO shuffling/gathers: 16 steps on one fixed slice
+    adv, tgt = learner._gae(gb, values, v_next)
+    N = adv.size
+    flat = {
+        "obs": obs_n.reshape(N, *obs_n.shape[2:]),
+        "action": learn_batch["action"].reshape(N, -1),
+        "behavior_logp": learn_batch["behavior_logp"].reshape(N),
+        "adv": adv.reshape(N),
+        "target": tgt.reshape(N),
+        "value_old": values.reshape(N),
+        "b_mean": learn_batch["behavior"]["mean"].reshape(N, -1),
+        "b_log_std": learn_batch["behavior"]["log_std"].reshape(N, -1),
+    }
+    mb0 = jax.tree.map(lambda x: x[: N // 4], flat)
+    grad_fn = jax.grad(learner._loss_fn, has_aux=True)
+
+    def steps16(s, k):
+        def body(carry, _):
+            params, opt_state = carry
+            grads, aux = grad_fn(params, mb0, s.kl_beta, jnp.float32(1.0))
+            updates, opt_state = learner.tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state), aux["kl"]
+
+        (p, o), kls = jax.lax.scan(body, (s.params, s.opt_state), None, length=16)
+        return s._replace(params=p, opt_state=o), kls[-1]
+
+    sj = jax.jit(steps16)
+    s1, kl1 = sj(state, key)
+    jax.device_get(kl1)
+    _timeit_chained(lambda s, k: sj(s, k), state, key, iters=2)
+    dt_s, _ = _timeit_chained(lambda s, k: sj(s, k), state, key)
+    out["gradsteps16_nogather_ms"] = dt_s / ITERS * 1e3
     return out
 
 
@@ -470,6 +588,33 @@ def main(argv=None) -> None:
         "",
         verdict,
     ]
+    at = head.get("attrib")
+    if at:
+        lines += [
+            "",
+            "## Learn-phase attribution (round-4 finding)",
+            "",
+            "Sub-programs compiled and timed separately at the headline "
+            "geometry (device_get-fenced, chained):",
+            "",
+            "| Component | ms/iter |",
+            "|---|---|",
+            f"| learn-only, `algo.shuffle='row'` (reference semantics: per-epoch row reshuffle) | {at['learn_row_ms']:.1f} |",
+            f"| learn-only, `algo.shuffle='block'` (default) | {head['learn_only_ms']:.1f} |",
+            f"| value forwards (2x model.apply over [T, B]) | {at['value_forwards_ms']:.1f} |",
+            f"| GAE recurrence | {at['gae_ms']:.1f} |",
+            f"| ALL 16 grad steps (4 epochs x 4 minibatches), no shuffling/gathers | {at['gradsteps16_nogather_ms']:.1f} |",
+            "",
+            "With row shuffling, learn time was dominated NOT by training "
+            "compute but by minibatch assembly: a ~1M-element argsort "
+            "permutation per epoch plus random row gathers whose "
+            "4-byte-row leaves (advantages, logps) walk the TPU scalar "
+            "unit. `algo.shuffle='block'` (learners/ppo.py `_sgd_epochs`) "
+            "permutes contiguous blocks instead — statistically benign "
+            "here because a flat-layout block is a same-timestep slab of "
+            "independent envs — and removes that cost wholesale; 'row' "
+            "remains selectable for exact reference semantics.",
+        ]
     if scaling:
         lines += [
             "",
@@ -486,11 +631,11 @@ def main(argv=None) -> None:
         lines += [
             "",
             "Horizon costs linearly (the env scan is sequential) and width "
-            "costs linearly beyond ~2k envs (elementwise ops saturate), so "
+            "costs linearly once elementwise env ops saturate, so "
             "throughput is flat-to-declining past the knee. bench.py "
-            "records the headline at its own swept knee (2048 x 128, "
-            "~3.2M steps/s); this sweep holds horizon at 256 to show the "
-            "width axis in isolation.",
+            "records the headline at its own swept knee (4096 x 256 since "
+            "the round-4 block-shuffle change); this sweep holds horizon "
+            "at 256 to show the width axis in isolation.",
         ]
     if head.get("trace_dir"):
         lines += [
@@ -508,6 +653,77 @@ def main(argv=None) -> None:
     with open("PERF.md", "w") as f:
         f.write("\n".join(lines))
     print("wrote PERF.md")
+    _update_readme(rows)
+
+
+def _update_readme(rows) -> None:
+    """Regenerate README's measured-throughput table from THIS run plus
+    the newest driver BENCH artifact on disk, so the three sources
+    (README / PERF.md / BENCH_r0N.json) cannot drift (round-3 VERDICT
+    weak #2). Rewrites only the marked block; wall-clock learning rows
+    outside the markers are separate end-to-end runs and stay manual."""
+    import glob
+    import os
+
+    start, end = "<!-- PERF-TABLE-START -->", "<!-- PERF-TABLE-END -->"
+    try:
+        with open("README.md") as f:
+            readme = f.read()
+    except OSError:
+        return
+    if start not in readme or end not in readme:
+        print("README markers not found; table not updated")
+        return
+
+    artifact = None
+    bench_files = sorted(glob.glob("BENCH_r*.json"))
+    if bench_files:
+        try:
+            with open(bench_files[-1]) as f:
+                data = json.load(f)
+            # driver artifacts wrap the bench line under "parsed"
+            parsed = data.get("parsed", data)
+            if "value" in parsed:
+                artifact = (os.path.basename(bench_files[-1]), parsed)
+        except (OSError, json.JSONDecodeError):
+            pass
+
+    head = rows[0]
+    art_txt = ""
+    if artifact:
+        vsb = artifact[1].get("vs_baseline", artifact[1]["value"] / 1e5)
+        art_txt = (
+            f" Driver artifact of record `{artifact[0]}`: "
+            f"{artifact[1]['value']:,.0f} steps/s ({vsb:,.0f}x target)."
+        )
+    body = [
+        "| Workload (BASELINE config class) | Geometry | env steps/s/chip | vs 100k north star |",
+        "|---|---|---|---|",
+    ]
+    for r in rows:
+        body.append(
+            "| {w} | {g} | **{s:,.0f}** | {x:,.0f}x |".format(
+                w=r["workload"], g=r["geometry"],
+                s=r["env_steps_per_s"], x=r["env_steps_per_s"] / 1e5,
+            )
+        )
+    body += [
+        "",
+        f"_Table generated by `perf_report.py` (device_get-fenced, this "
+        f"run's measurements; headline iter {head['iter_ms']:.1f} ms, "
+        f"MFU {head.get('mfu', 0) * 100:.2f}%).{art_txt} Full breakdown, "
+        "learn-phase attribution, and geometry sweep: `PERF.md`._",
+    ]
+    new = (
+        readme[: readme.index(start) + len(start)]
+        + "\n"
+        + "\n".join(body)
+        + "\n"
+        + readme[readme.index(end):]
+    )
+    with open("README.md", "w") as f:
+        f.write(new)
+    print("updated README.md perf table")
 
 
 if __name__ == "__main__":

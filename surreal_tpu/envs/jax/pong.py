@@ -67,12 +67,14 @@ def _serve(key: jax.Array, toward_agent: jax.Array):
     return jnp.asarray([0.5, 0.5], jnp.float32), jnp.stack([vx, vy])
 
 
-def _render(ball, agent_y, opp_y) -> jax.Array:
-    """[_RES, _RES] uint8 frame: rows = y (top=0), cols = x."""
-    grid = (jnp.arange(_RES, dtype=jnp.float32) + 0.5) / _RES
+def _render(ball, agent_y, opp_y, res: int = _RES) -> jax.Array:
+    """[res, res] uint8 frame: rows = y (top=0), cols = x. The court is
+    normalized, so resolution is render-only — the 16x16 variant plays the
+    identical game."""
+    grid = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
     ys = grid[:, None]  # [R, 1]
     xs = grid[None, :]  # [1, R]
-    cell = 1.0 / _RES
+    cell = 1.0 / res
     ball_px = (jnp.abs(ys - ball[1]) <= cell) & (jnp.abs(xs - ball[0]) <= cell)
     agent_px = (jnp.abs(ys - agent_y) <= _PADDLE_HALF) & (
         jnp.abs(xs - _AGENT_X) <= cell
@@ -83,6 +85,7 @@ def _render(ball, agent_y, opp_y) -> jax.Array:
 
 class Pong(JaxEnv):
     max_episode_steps = 2048
+    res = _RES  # render resolution; physics is resolution-independent
 
     specs = EnvSpecs(
         obs=ArraySpec(shape=(_RES, _RES, 2), dtype=np.dtype(np.uint8), name="pixels"),
@@ -99,7 +102,7 @@ class Pong(JaxEnv):
             opp_y=jnp.asarray(0.5, jnp.float32),
             agent_score=jnp.zeros((), jnp.int32),
             opp_score=jnp.zeros((), jnp.int32),
-            prev_frame=_render(ball, 0.5, 0.5),
+            prev_frame=_render(ball, 0.5, 0.5, self.res),
             key=key,
         )
         return state, self._obs(state)
@@ -159,7 +162,7 @@ class Pong(JaxEnv):
         ball = jnp.where(point, serve_ball, ball)
         vel = jnp.where(point, serve_vel, vel)
 
-        frame = _render(ball, agent_y, opp_y)
+        frame = _render(ball, agent_y, opp_y, self.res)
         new_state = PongState(
             ball=ball,
             vel=vel,
@@ -179,3 +182,16 @@ class Pong(JaxEnv):
     @staticmethod
     def _obs(state: PongState) -> jax.Array:
         return jnp.stack([state.prev_frame, state.prev_frame], axis=-1)
+
+
+class PongSmall(Pong):
+    """16x16 Pong (``jax:pong16``): the same court, physics, and opponent —
+    resolution is render-only — at a size whose CNN forward is cheap enough
+    for the CPU-sim suite to LEARN on (the in-suite pixel-learning guard,
+    round-3 VERDICT missing #5; the real-chip result stays the 42x42 env)."""
+
+    res = 16
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(16, 16, 2), dtype=np.dtype(np.uint8), name="pixels"),
+        action=DiscreteSpec(shape=(), dtype=np.dtype(np.int32), name="action", n=3),
+    )

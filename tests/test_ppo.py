@@ -507,3 +507,58 @@ def test_host_overlap_hides_rollout_latency(tmp_path, monkeypatch):
         f"floor {alternation:.3f}s (rollout {rollout_actual:.3f} + learn "
         f"{learn_actual:.3f})"
     )
+
+
+def test_block_layout_selection_rules():
+    """The block-shuffle plan (learners/ppo.py _block_layout) — default
+    minibatch semantics for every PPO user, so the gates get direct unit
+    coverage: indivisible domains and fat rows MUST fall back to row mode
+    (fat-row block gathers measured 63,000 ms vs 91 ms on nut_pixels),
+    degenerate block counts too."""
+    from surreal_tpu.learners.ppo import _block_layout
+
+    assert _block_layout(1024 * 128, 4, 100) == 64   # standard geometry
+    assert _block_layout(64, 4, 16) == 16            # small but blockable
+    assert _block_layout(100, 8, 16) == 0            # domain % num_mb != 0
+    assert _block_layout(1024 * 128, 4, 16384) == 0  # fat rows (pixels)
+    assert _block_layout(1000, 4, 16) == 0           # only 2 blocks fit
+    # divisibility invariant: chosen layout always tiles the domain
+    # exactly (no statically-excluded tail rows)
+    for domain, num_mb in [(1024 * 128, 4), (64, 4), (4096, 8)]:
+        k = _block_layout(domain, num_mb, 100)
+        if k:
+            assert domain % (num_mb * k) == 0
+
+
+def test_shuffle_block_matches_row_for_single_minibatch():
+    """With one minibatch per epoch both modes train on ALL rows in one
+    gradient, so block and row must produce the same update (up to f32
+    reduction order) — pins that block mode neither drops nor duplicates
+    samples."""
+    batch = _fake_batch(jax.random.key(1), T=16, B=8)
+    results = {}
+    for shuffle in ("row", "block"):
+        learner = build_learner(
+            Config(algo=Config(name="ppo", epochs=1, num_minibatches=1,
+                               shuffle=shuffle)),
+            _continuous_specs(),
+        )
+        state = learner.init(jax.random.key(0))
+        new_state, metrics = jax.jit(learner.learn)(
+            state, batch, jax.random.key(2)
+        )
+        results[shuffle] = (new_state, metrics)
+    for k in results["row"][1]:
+        np.testing.assert_allclose(
+            float(results["row"][1][k]), float(results["block"][1][k]),
+            rtol=1e-3, atol=1e-4,
+            err_msg=f"metric {k} diverges between shuffle=row and block",
+        )
+    # bf16 activations + a different gather order shift reductions by
+    # ~1e-5 absolute; a dropped or duplicated minibatch row would move
+    # params by the per-row gradient scale (~1e-3 here), well past this
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-2, atol=1e-4),
+        results["row"][0].params,
+        results["block"][0].params,
+    )
