@@ -259,27 +259,61 @@ class PPOLearner(Learner):
 
     # -- sequence acting (model.encoder.kind='trajectory') -------------------
     def act_init(self, num_envs: int):
-        """Segment context: a zero obs buffer of horizon length plus the
-        write position. Collectors call this at each rollout start, so the
-        policy's context resets on segment boundaries — exactly the
-        conditioning ``_learn_seq`` recomputes (the PPO ratio contract)."""
+        """Segment context, reset at each rollout start so the policy's
+        conditioning is exactly what ``_learn_seq`` recomputes (the PPO
+        ratio contract). Two carry forms by ``encoder.act_impl``:
+
+        - 'kv': per-layer K/V caches of horizon length — incremental
+          decode, O(T) attention per step;
+        - 'padded': a zero obs buffer re-encoded in full each step —
+          O(T^2) per step, the simple reference form both paths are
+          equivalence-tested against.
+        """
         if not self.seq_policy:
             return None
+        enc = self.config.model.encoder
         T = int(self.config.algo.horizon)
+        if enc.get("act_impl", "kv") == "padded":
+            return {
+                "buf": jnp.zeros(
+                    (num_envs, T, *self.specs.obs.shape), jnp.float32
+                ),
+                "pos": jnp.zeros((), jnp.int32),
+            }
+        mk = lambda: jnp.zeros(
+            (num_envs, T, int(enc.num_heads), int(enc.head_dim)), jnp.bfloat16
+        )
         return {
-            "buf": jnp.zeros((num_envs, T, *self.specs.obs.shape), jnp.float32),
+            "cache": [
+                {"k": mk(), "v": mk()} for _ in range(int(enc.num_layers))
+            ],
             "pos": jnp.zeros((), jnp.int32),
         }
 
     def act_step(self, state, act_carry, obs, key, mode=TRAINING):
-        """Deliberate simplicity tradeoff: each step re-runs the full
-        padded [B, T] segment forward and reads one position — O(T^2)
-        attention per rollout vs a KV-cached incremental step, but ONE
-        compiled program whose per-position outputs match ``_learn_seq``
-        bit-for-bit in structure. The KV-cache is the optimization seam
-        when long-horizon acting cost shows up in profiles."""
+        """Sequence acting. Default ('kv'): incremental decode against
+        per-layer K/V caches — O(T) attention per step. 'padded' re-runs
+        the full zero-padded segment and reads one position — O(T^2) per
+        step, kept as the simple reference form the kv path is
+        equivalence-tested against; both reproduce ``_learn_seq``'s
+        per-position conditioning (the PPO ratio contract)."""
         if not self.seq_policy:
             return super().act_step(state, act_carry, obs, key, mode)
+        if "cache" in act_carry:
+            # incremental decode: one position through the trunk against
+            # the K/V caches; positions > pos in the caches are masked,
+            # so the wrap reset only needs the index (stale K/V rows are
+            # overwritten as the new segment advances)
+            cache, pos = act_carry["cache"], act_carry["pos"]
+            T = cache[0]["k"].shape[1]
+            pos = jnp.where(pos >= T, 0, pos)
+            out_t, cache = self.model.apply(
+                state.params,
+                self._norm_obs(state.obs_stats, obs.astype(jnp.float32)),
+                cache=cache, pos=pos,
+            )
+            action, info = self._head_act(out_t, key, mode)
+            return action, info, {"cache": cache, "pos": pos + 1}
         buf, pos = act_carry["buf"], act_carry["pos"]
         T = buf.shape[1]
         # long eval episodes outrun one segment: re-segment (fresh
@@ -596,8 +630,11 @@ class PPOLearner(Learner):
         the memoryless path, all forced by history conditioning:
 
         - the model applies over WHOLE segments [B, T, obs]; per-position
-          outputs reproduce exactly what ``act_step`` computed during the
-          rollout (same prefix, same padding) — the PPO ratio contract;
+          outputs reproduce ``act_step``'s rollout-time conditioning —
+          the same causal prefix per position (the PPO ratio contract).
+          Agreement is exact in structure and bf16-tight in value: the
+          default kv decode and the padded acting path both match this
+          recompute within bf16 program-shape tolerance (tested);
         - minibatches are drawn over ENVS, never flat (t, b) samples — a
           shuffled sample has no prefix to condition on (the LSTM-PPO
           discipline, applied to attention);

@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from surreal_tpu.models.encoders import orthogonal_init
-from surreal_tpu.ops.ring_attention import full_attention, ring_self_attention
+from surreal_tpu.ops.ring_attention import (
+    decode_attention,
+    full_attention,
+    ring_self_attention,
+)
 
 
 class CausalSelfAttention(nn.Module):
@@ -39,27 +43,42 @@ class CausalSelfAttention(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
-        B, T, E = x.shape
+    def __call__(self, x: jax.Array, *, cache=None, pos=None):
+        """Full path: x [B, T, E] -> [B, T, E]. Decode path (``cache`` a
+        {'k','v'} dict of [B, T, H, D], ``pos`` the write index): x is
+        ONE position [B, E]; returns ([B, E], new_cache) — O(T) per step
+        instead of re-attending the whole padded segment. Param tree is
+        identical in both modes (same named submodules)."""
         H, D = self.num_heads, self.head_dim
         proj = lambda name: nn.DenseGeneral(
             (H, D), axis=-1, name=name,
             dtype=self.compute_dtype, param_dtype=self.param_dtype,
             kernel_init=orthogonal_init(1.0),
         )
+        out_proj = nn.DenseGeneral(
+            x.shape[-1], axis=-1, name="o",
+            dtype=self.compute_dtype, param_dtype=self.param_dtype,
+            kernel_init=orthogonal_init(1.0),
+        )
         q, k, v = proj("q")(x), proj("k")(x), proj("v")(x)
+        if cache is not None:
+            B = x.shape[0]
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                cache["k"], k[:, None].astype(cache["k"].dtype), pos, axis=1
+            )
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                cache["v"], v[:, None].astype(cache["v"].dtype), pos, axis=1
+            )
+            out = decode_attention(q, k_cache, v_cache, pos)  # [B, H, D]
+            return out_proj(out.reshape(B, H * D)), {"k": k_cache, "v": v_cache}
+        B, T, _ = x.shape
         if self.mesh is not None:
             out = ring_self_attention(
                 self.mesh, q, k, v, causal=True, axis=self.sp_axis
             )
         else:
             out = full_attention(q, k, v, causal=True)
-        out = out.reshape(B, T, H * D)
-        return nn.DenseGeneral(
-            E, axis=-1, name="o",
-            dtype=self.compute_dtype, param_dtype=self.param_dtype,
-            kernel_init=orthogonal_init(1.0),
-        )(out)
+        return out_proj(out.reshape(B, T, H * D))
 
 
 class TrajectoryEncoder(nn.Module):
@@ -77,28 +96,45 @@ class TrajectoryEncoder(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, obs: jax.Array) -> jax.Array:
-        B, T, _ = obs.shape
-        x = nn.Dense(
+    def __call__(self, obs: jax.Array, *, cache=None, pos=None):
+        """Full path: [B, T, obs] -> [B, T, features]. Decode path
+        (``cache`` a per-layer list of K/V dicts, ``pos`` the position):
+        obs is [B, obs]; returns ([B, features], new_cache)."""
+        decode = cache is not None
+        embed = nn.Dense(
             self.features, dtype=self.compute_dtype,
             param_dtype=self.param_dtype, kernel_init=orthogonal_init(1.0),
             name="embed",
-        )(obs.astype(self.compute_dtype))
-        pos = self.param(
+        )
+        pos_embed = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
             (self.max_len, self.features),
             self.param_dtype,
         )
-        x = x + pos[:T].astype(self.compute_dtype)[None]
+        x = embed(obs.astype(self.compute_dtype))
+        if decode:
+            x = x + jax.lax.dynamic_index_in_dim(
+                pos_embed.astype(self.compute_dtype), pos, keepdims=False
+            )
+        else:
+            T = obs.shape[1]
+            x = x + pos_embed[:T].astype(self.compute_dtype)[None]
+        new_cache = []
         for i in range(self.num_layers):
             h = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_a{i}")(x)
-            x = x + CausalSelfAttention(
+            attn = CausalSelfAttention(
                 num_heads=self.num_heads, head_dim=self.head_dim,
                 mesh=self.mesh, sp_axis=self.sp_axis,
                 compute_dtype=self.compute_dtype,
                 param_dtype=self.param_dtype, name=f"attn{i}",
-            )(h)
+            )
+            if decode:
+                a, c_i = attn(h, cache=cache[i], pos=pos)
+                new_cache.append(c_i)
+                x = x + a
+            else:
+                x = x + attn(h)
             h = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_m{i}")(x)
             h = nn.Dense(
                 4 * self.features, dtype=self.compute_dtype,
@@ -112,9 +148,10 @@ class TrajectoryEncoder(nn.Module):
                 kernel_init=orthogonal_init(1.0), name=f"mlp_out{i}",
             )(h)
         # heads downstream do numerically delicate work in f32
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(
+        out = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(
             x.astype(jnp.float32)
         )
+        return (out, new_cache) if decode else out
 
 
 class TrajectoryPPOModel(nn.Module):
@@ -132,15 +169,21 @@ class TrajectoryPPOModel(nn.Module):
     sp_axis: str = "sp"
 
     @nn.compact
-    def __call__(self, obs_seq: jax.Array):
+    def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None):
         from surreal_tpu.models.ppo_net import PolicyOutput
 
         cfg = self.encoder_cfg
-        h = TrajectoryEncoder(
+        trunk = TrajectoryEncoder(
             features=cfg["features"], num_layers=cfg["num_layers"],
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
             mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
-        )(obs_seq.astype(jnp.float32))
+        )
+        if cache is not None:  # incremental acting: obs_seq is [B, obs]
+            h, new_cache = trunk(
+                obs_seq.astype(jnp.float32), cache=cache, pos=pos
+            )
+        else:
+            h = trunk(obs_seq.astype(jnp.float32))
         mean = nn.Dense(
             self.act_dim, kernel_init=orthogonal_init(0.01),
             param_dtype=jnp.float32, name="mean",
@@ -153,11 +196,12 @@ class TrajectoryPPOModel(nn.Module):
             1, kernel_init=orthogonal_init(1.0),
             param_dtype=jnp.float32, name="value",
         )(h).astype(jnp.float32)
-        return PolicyOutput(
+        out = PolicyOutput(
             mean=mean,
             log_std=jnp.broadcast_to(log_std, mean.shape),
             value=value[..., 0],
         )
+        return (out, new_cache) if cache is not None else out
 
 
 class TrajectoryCategoricalPPOModel(nn.Module):
@@ -169,15 +213,21 @@ class TrajectoryCategoricalPPOModel(nn.Module):
     sp_axis: str = "sp"
 
     @nn.compact
-    def __call__(self, obs_seq: jax.Array):
+    def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None):
         from surreal_tpu.models.ppo_net import CategoricalOutput
 
         cfg = self.encoder_cfg
-        h = TrajectoryEncoder(
+        trunk = TrajectoryEncoder(
             features=cfg["features"], num_layers=cfg["num_layers"],
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
             mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
-        )(obs_seq.astype(jnp.float32))
+        )
+        if cache is not None:  # incremental acting: obs_seq is [B, obs]
+            h, new_cache = trunk(
+                obs_seq.astype(jnp.float32), cache=cache, pos=pos
+            )
+        else:
+            h = trunk(obs_seq.astype(jnp.float32))
         logits = nn.Dense(
             self.n_actions, kernel_init=orthogonal_init(0.01),
             param_dtype=jnp.float32, name="logits",
@@ -186,4 +236,5 @@ class TrajectoryCategoricalPPOModel(nn.Module):
             1, kernel_init=orthogonal_init(1.0),
             param_dtype=jnp.float32, name="value",
         )(h).astype(jnp.float32)
-        return CategoricalOutput(logits=logits, value=value[..., 0])
+        out = CategoricalOutput(logits=logits, value=value[..., 0])
+        return (out, new_cache) if cache is not None else out

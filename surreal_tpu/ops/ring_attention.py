@@ -73,6 +73,29 @@ def full_attention(q, k, v, causal: bool = False):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def decode_attention(q_t, k_cache, v_cache, pos):
+    """Single-position causal attention against a K/V cache — the O(T)
+    incremental acting step for trajectory policies, matching
+    ``full_attention``'s numerics exactly (f32 scores/softmax, 1/sqrt(D)
+    scale, value contraction in f32).
+
+    q_t [B, H, D] (the query at position ``pos``); k_cache/v_cache
+    [B, T, H, D] with positions > ``pos`` ignored via the mask (their
+    contents may be stale/zero). Returns [B, H, D] in q_t's dtype.
+    """
+    T = k_cache.shape[1]
+    D = q_t.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
+    scores = (
+        jnp.einsum("bhd,bkhd->bhk", q_t, k_cache).astype(jnp.float32) * scale
+    )
+    mask = jnp.arange(T) <= pos
+    scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhk,bkhd->bhd", p, v_cache.astype(jnp.float32))
+    return out.astype(q_t.dtype)
+
+
 def ring_attention(
     q, k, v, axis_name: str, causal: bool = False, remat: bool = True
 ):

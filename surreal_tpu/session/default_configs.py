@@ -42,6 +42,10 @@ BASE_LEARNER_CONFIG = Config(
             num_layers=2,
             num_heads=4,
             head_dim=16,
+            # trajectory acting: 'kv' (incremental decode against a K/V
+            # cache — O(T) per step) | 'padded' (re-run the full padded
+            # segment each step — O(T^2), the simple reference form)
+            act_impl="kv",
         ),
         cnn=Config(
             enabled=False,          # pixel observations -> Nature-CNN stem
