@@ -52,9 +52,9 @@ class Learner(abc.ABC):
             and not self.supports_trajectory_encoder
         ):
             raise ValueError(
-                "model.encoder.kind='trajectory' is a PPO-family seam "
-                f"(got algo {learner_config.algo.name!r}); ddpg/impala "
-                "use their own model builds"
+                "model.encoder.kind='trajectory' is an on-policy seam "
+                f"(ppo, impala; got algo {learner_config.algo.name!r}); "
+                "ddpg uses its own actor/critic model build"
             )
 
     # -- state ---------------------------------------------------------------
@@ -87,7 +87,7 @@ class Learner(abc.ABC):
     # collector runs unchanged; drivers that cannot thread a carry (host
     # SEED plane, remote actors) gate on `requires_act_carry`.
     requires_act_carry: bool = False
-    supports_trajectory_encoder: bool = False  # PPOLearner implements it
+    supports_trajectory_encoder: bool = False  # PPO/IMPALA implement it
 
     def act_init(self, num_envs: int) -> Any:
         """Fresh acting carry for a rollout segment (None = memoryless)."""

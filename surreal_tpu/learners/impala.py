@@ -21,7 +21,8 @@ import numpy as np
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
-from surreal_tpu.learners.base import EVAL_DETERMINISTIC, TRAINING, Learner
+from surreal_tpu.learners.base import TRAINING, Learner
+from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
 from surreal_tpu.ops.running_stats import RunningStats, init_stats, normalize, update_stats
@@ -51,11 +52,22 @@ class IMPALAState(NamedTuple):
     iteration: jax.Array
 
 
-class IMPALALearner(Learner):
+class IMPALALearner(SequenceActingMixin, Learner):
+    supports_trajectory_encoder = True  # single-update-over-sequences
+                                        # learn fits trajectory policies
+                                        # with no minibatch surgery
+
     def __init__(self, learner_config, env_specs: EnvSpecs):
         super().__init__(learner_config, env_specs)
         self.discrete = env_specs.discrete
-        if self.discrete:
+        enc = learner_config.model.get("encoder", None)
+        self.seq_policy = bool(enc is not None and enc.get("kind") == "trajectory")
+        self.requires_act_carry = self.seq_policy
+        if self.seq_policy:
+            self.model = build_seq_model(
+                learner_config.model, env_specs, learner_config.algo.init_log_std
+            )
+        elif self.discrete:
             self.model = CategoricalPPOModel(
                 model_cfg=learner_config.model.to_dict(),
                 n_actions=env_specs.action.n,
@@ -79,7 +91,10 @@ class IMPALALearner(Learner):
         )
 
     def init(self, key: jax.Array) -> IMPALAState:
-        obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
+        if self.seq_policy:
+            obs = jnp.zeros((1, 1, *self.specs.obs.shape), self.specs.obs.dtype)
+        else:
+            obs = jnp.zeros((1, *self.specs.obs.shape), self.specs.obs.dtype)
         params = self.model.init(key, obs)
         return IMPALAState(
             params=params,
@@ -104,22 +119,15 @@ class IMPALALearner(Learner):
 
     # -- acting (same behavior-info contract as PPO) --------------------------
     def act(self, state: IMPALAState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
+        if self.seq_policy:
+            raise RuntimeError(
+                "trajectory policies condition on history: act through "
+                "act_init/act_step (the device collectors and evaluator "
+                "do); host SEED planes and remote actors do not support "
+                "model.encoder.kind='trajectory'"
+            )
         out = self.model.apply(state.params, self._norm_obs(state.obs_stats, obs))
-        if self.discrete:
-            if mode == EVAL_DETERMINISTIC:
-                action = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
-            else:
-                action = D.categorical_sample(key, out.logits).astype(jnp.int32)
-            logp = D.categorical_logp(out.logits, action)
-            return action, {"logp": logp, "logits": out.logits, "value": out.value}
-        if mode == EVAL_DETERMINISTIC:
-            action = out.mean
-        else:
-            action = D.diag_gauss_sample(key, out.mean, out.log_std)
-        logp = D.diag_gauss_logp(out.mean, out.log_std, action)
-        return action, {
-            "logp": logp, "mean": out.mean, "log_std": out.log_std, "value": out.value
-        }
+        return self._head_act(out, key, mode)
 
     # -- learning ------------------------------------------------------------
     def learn(self, state: IMPALAState, batch: dict, key: jax.Array, axis_name=None):
@@ -135,10 +143,27 @@ class IMPALALearner(Learner):
         obs = self._norm_obs(obs_stats, batch["obs"])
         next_obs = self._norm_obs(obs_stats, batch["next_obs"])
 
+        T = batch["reward"].shape[0]
+
         def loss_fn(params):
-            out = self.model.apply(params, obs)
-            values = out.value
-            values_next = self.model.apply(params, next_obs).value
+            if self.seq_policy:
+                # ONE extended [B, T+1] apply: per-position outputs
+                # conditioned causally on the segment prefix (exactly the
+                # conditioning act_step used during the rollout), with
+                # the V-trace bootstrap read from the shifted positions —
+                # same truncation-boundary caveat as PPO's _learn_seq
+                obs_bt = jnp.swapaxes(obs, 0, 1)
+                ext = jnp.concatenate([obs_bt, next_obs[-1][:, None]], axis=1)
+                out_ext = self.model.apply(params, ext)
+                out = jax.tree.map(
+                    lambda x: jnp.swapaxes(x[:, :T], 0, 1), out_ext
+                )
+                values = out.value
+                values_next = jnp.swapaxes(out_ext.value[:, 1:], 0, 1)
+            else:
+                out = self.model.apply(params, obs)
+                values = out.value
+                values_next = self.model.apply(params, next_obs).value
             if self.discrete:
                 logp = D.categorical_logp(out.logits, batch["action"])
                 entropy = D.categorical_entropy(out.logits).mean()

@@ -32,6 +32,7 @@ import optax
 
 from surreal_tpu.envs.base import EnvSpecs
 from surreal_tpu.learners.base import EVAL_DETERMINISTIC, TRAINING, Learner
+from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
 from surreal_tpu.ops.running_stats import (
@@ -116,7 +117,7 @@ class PPOState(NamedTuple):
     iteration: jax.Array  # int32
 
 
-class PPOLearner(Learner):
+class PPOLearner(SequenceActingMixin, Learner):
     supports_trajectory_encoder = True
 
     def __init__(self, learner_config, env_specs: EnvSpecs):
@@ -127,13 +128,9 @@ class PPOLearner(Learner):
         self.seq_policy = bool(enc is not None and enc.get("kind") == "trajectory")
         self.requires_act_carry = self.seq_policy
         if self.seq_policy:
-            if learner_config.model.cnn.enabled:
-                raise ValueError(
-                    "model.encoder.kind='trajectory' takes flat vector obs; "
-                    "combine it with pixel envs via a CNN feature env "
-                    "wrapper, not model.cnn.enabled"
-                )
-            self.model = self._build_seq_model(mesh=None)
+            self.model = build_seq_model(
+                learner_config.model, env_specs, algo.init_log_std
+            )
         elif self.discrete:
             self.model = CategoricalPPOModel(
                 model_cfg=learner_config.model.to_dict(),
@@ -147,33 +144,6 @@ class PPOLearner(Learner):
                 init_log_std=algo.init_log_std,
             )
         self.tx = self._make_optimizer(learner_config.optimizer)
-
-    def _build_seq_model(self, mesh, sp_axis: str = "sp"):
-        from surreal_tpu.models.attention import (
-            TrajectoryCategoricalPPOModel,
-            TrajectoryPPOModel,
-        )
-
-        enc_cfg = self.config.model.encoder.to_dict()
-        if self.discrete:
-            return TrajectoryCategoricalPPOModel(
-                encoder_cfg=enc_cfg, n_actions=self.specs.action.n,
-                mesh=mesh, sp_axis=sp_axis,
-            )
-        return TrajectoryPPOModel(
-            encoder_cfg=enc_cfg,
-            act_dim=int(self.specs.action.shape[0]),
-            init_log_std=self.config.algo.init_log_std,
-            mesh=mesh, sp_axis=sp_axis,
-        )
-
-    def rebind_mesh(self, mesh, sp_axis: str = "sp") -> None:
-        """Route the trajectory encoder's attention through the ring over
-        ``mesh[sp_axis]`` (ops/ring_attention.py) — params are unchanged
-        (same module tree, different attention schedule), so this is safe
-        after ``init``/restore. No-op for memoryless policies."""
-        if self.seq_policy:
-            self.model = self._build_seq_model(mesh=mesh, sp_axis=sp_axis)
 
     def _make_optimizer(self, opt_cfg) -> optax.GradientTransformation:
         if opt_cfg.lr_schedule == "linear":
@@ -220,30 +190,6 @@ class PPOLearner(Learner):
         return normalize(stats, obs.astype(jnp.float32))
 
     # -- acting --------------------------------------------------------------
-    def _head_act(self, out, key: jax.Array, mode: str):
-        """Sample/argmax + behavior info from head outputs (shared by the
-        memoryless ``act`` and the sequence ``act_step``)."""
-        if self.discrete:
-            if mode == EVAL_DETERMINISTIC:
-                action = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
-            else:
-                action = D.categorical_sample(key, out.logits).astype(jnp.int32)
-            logp = D.categorical_logp(out.logits, action)
-            info = {"logp": logp, "logits": out.logits, "value": out.value}
-        else:
-            if mode == EVAL_DETERMINISTIC:
-                action = out.mean
-            else:
-                action = D.diag_gauss_sample(key, out.mean, out.log_std)
-            logp = D.diag_gauss_logp(out.mean, out.log_std, action)
-            info = {
-                "logp": logp,
-                "mean": out.mean,
-                "log_std": out.log_std,
-                "value": out.value,
-            }
-        return action, info
-
     def act(self, state: PPOState, obs: jax.Array, key: jax.Array, mode: str = TRAINING):
         if self.seq_policy:
             raise RuntimeError(
@@ -256,83 +202,6 @@ class PPOLearner(Learner):
             state.params, self._norm_obs(state.obs_stats, obs)
         )
         return self._head_act(out, key, mode)
-
-    # -- sequence acting (model.encoder.kind='trajectory') -------------------
-    def act_init(self, num_envs: int):
-        """Segment context, reset at each rollout start so the policy's
-        conditioning is exactly what ``_learn_seq`` recomputes (the PPO
-        ratio contract). Two carry forms by ``encoder.act_impl``:
-
-        - 'kv': per-layer K/V caches of horizon length — incremental
-          decode, O(T) attention per step;
-        - 'padded': a zero obs buffer re-encoded in full each step —
-          O(T^2) per step, the simple reference form both paths are
-          equivalence-tested against.
-        """
-        if not self.seq_policy:
-            return None
-        enc = self.config.model.encoder
-        T = int(self.config.algo.horizon)
-        if enc.get("act_impl", "kv") == "padded":
-            return {
-                "buf": jnp.zeros(
-                    (num_envs, T, *self.specs.obs.shape), jnp.float32
-                ),
-                "pos": jnp.zeros((), jnp.int32),
-            }
-        mk = lambda: jnp.zeros(
-            (num_envs, T, int(enc.num_heads), int(enc.head_dim)), jnp.bfloat16
-        )
-        return {
-            "cache": [
-                {"k": mk(), "v": mk()} for _ in range(int(enc.num_layers))
-            ],
-            "pos": jnp.zeros((), jnp.int32),
-        }
-
-    def act_step(self, state, act_carry, obs, key, mode=TRAINING):
-        """Sequence acting. Default ('kv'): incremental decode against
-        per-layer K/V caches — O(T) attention per step. 'padded' re-runs
-        the full zero-padded segment and reads one position — O(T^2) per
-        step, kept as the simple reference form the kv path is
-        equivalence-tested against; both reproduce ``_learn_seq``'s
-        per-position conditioning (the PPO ratio contract)."""
-        if not self.seq_policy:
-            return super().act_step(state, act_carry, obs, key, mode)
-        if "cache" in act_carry:
-            # incremental decode: one position through the trunk against
-            # the K/V caches; positions > pos in the caches are masked,
-            # so the wrap reset only needs the index (stale K/V rows are
-            # overwritten as the new segment advances)
-            cache, pos = act_carry["cache"], act_carry["pos"]
-            T = cache[0]["k"].shape[1]
-            pos = jnp.where(pos >= T, 0, pos)
-            out_t, cache = self.model.apply(
-                state.params,
-                self._norm_obs(state.obs_stats, obs.astype(jnp.float32)),
-                cache=cache, pos=pos,
-            )
-            action, info = self._head_act(out_t, key, mode)
-            return action, info, {"cache": cache, "pos": pos + 1}
-        buf, pos = act_carry["buf"], act_carry["pos"]
-        T = buf.shape[1]
-        # long eval episodes outrun one segment: re-segment (fresh
-        # context), matching how training segments the stream
-        wrap = pos >= T
-        buf = jnp.where(wrap, jnp.zeros_like(buf), buf)
-        pos = jnp.where(wrap, 0, pos)
-        buf = jax.lax.dynamic_update_slice_in_dim(
-            buf, obs.astype(jnp.float32)[:, None], pos, axis=1
-        )
-        # causal attention: position `pos` sees only the 0..pos prefix —
-        # the zero padding at future positions is unread by construction
-        out = self.model.apply(
-            state.params, self._norm_obs(state.obs_stats, buf)
-        )
-        at = lambda x: jax.lax.dynamic_index_in_dim(x, pos, axis=1, keepdims=False)
-        out_t = jax.tree.map(at, out)
-        action, info = self._head_act(out_t, key, mode)
-        return action, info, {"buf": buf, "pos": pos + 1}
 
     # -- learning ------------------------------------------------------------
     def learn(self, state: PPOState, batch: dict, key: jax.Array, axis_name=None):

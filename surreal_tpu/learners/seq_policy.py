@@ -1,0 +1,172 @@
+"""Shared policy-head sampling + trajectory-policy acting, mixed into the
+on-policy learners (PPO, IMPALA).
+
+``PolicyHeadMixin`` owns the one place actions are sampled from head
+outputs (diagonal-Gaussian or categorical — the reference duplicated this
+across its agent classes). ``SequenceActingMixin`` owns the trajectory
+policy's acting carry (SURVEY.md §5.7 long-context seam): segment-aligned
+context so rollout-time conditioning is exactly what the learner
+recomputes over whole segments (the importance-ratio contract), with two
+interchangeable implementations selected by ``model.encoder.act_impl``:
+
+- ``'kv'`` (default): incremental decode against per-layer K/V caches —
+  O(T) attention per env step;
+- ``'padded'``: re-encode the zero-padded segment and read one position —
+  O(T^2) per step, the simple reference form the kv path is
+  equivalence-tested against (tests/test_trajectory_policy.py).
+
+Host classes provide: ``model`` (decode-capable when ``seq_policy``),
+``config`` (algo.horizon, model.encoder), ``specs``, ``discrete``,
+``seq_policy``, and ``_norm_obs``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.learners.base import EVAL_DETERMINISTIC, TRAINING
+from surreal_tpu.ops import distributions as D
+
+
+class PolicyHeadMixin:
+    def _head_act(self, out, key: jax.Array, mode: str):
+        """Sample/argmax + behavior info from head outputs (shared by the
+        memoryless ``act`` and the sequence ``act_step``)."""
+        if self.discrete:
+            if mode == EVAL_DETERMINISTIC:
+                action = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)
+            else:
+                action = D.categorical_sample(key, out.logits).astype(jnp.int32)
+            logp = D.categorical_logp(out.logits, action)
+            info = {"logp": logp, "logits": out.logits, "value": out.value}
+        else:
+            if mode == EVAL_DETERMINISTIC:
+                action = out.mean
+            else:
+                action = D.diag_gauss_sample(key, out.mean, out.log_std)
+            logp = D.diag_gauss_logp(out.mean, out.log_std, action)
+            info = {
+                "logp": logp,
+                "mean": out.mean,
+                "log_std": out.log_std,
+                "value": out.value,
+            }
+        return action, info
+
+
+class SequenceActingMixin(PolicyHeadMixin):
+    def rebind_mesh(self, mesh, sp_axis: str = "sp") -> None:
+        """Route the trajectory encoder's attention through the ring over
+        ``mesh[sp_axis]`` (ops/ring_attention.py) — params are unchanged
+        (same module tree, different attention schedule), so this is safe
+        after ``init``/restore. No-op for memoryless policies."""
+        if self.seq_policy:
+            self.model = build_seq_model(
+                self.config.model, self.specs,
+                self.config.algo.init_log_std, mesh=mesh, sp_axis=sp_axis,
+            )
+
+    # -- sequence acting (model.encoder.kind='trajectory') -------------------
+    def act_init(self, num_envs: int):
+        """Segment context, reset at each rollout start so the policy's
+        conditioning is exactly what the sequence learn recomputes (the
+        importance-ratio contract). Carry form follows
+        ``encoder.act_impl`` (see module docstring)."""
+        if not self.seq_policy:
+            return None
+        enc = self.config.model.encoder
+        T = int(self.config.algo.horizon)
+        if enc.get("act_impl", "kv") == "padded":
+            return {
+                "buf": jnp.zeros(
+                    (num_envs, T, *self.specs.obs.shape), jnp.float32
+                ),
+                "pos": jnp.zeros((), jnp.int32),
+            }
+        mk = lambda: jnp.zeros(
+            (num_envs, T, int(enc.num_heads), int(enc.head_dim)), jnp.bfloat16
+        )
+        return {
+            "cache": [
+                {"k": mk(), "v": mk()} for _ in range(int(enc.num_layers))
+            ],
+            "pos": jnp.zeros((), jnp.int32),
+        }
+
+    def act_step(self, state, act_carry, obs, key, mode=TRAINING):
+        """Sequence acting. Default ('kv'): incremental decode against
+        per-layer K/V caches — O(T) attention per step. 'padded' re-runs
+        the full zero-padded segment and reads one position — O(T^2) per
+        step, kept as the simple reference form the kv path is
+        equivalence-tested against; both reproduce the sequence learn's
+        per-position conditioning (the importance-ratio contract)."""
+        if not self.seq_policy:
+            return super().act_step(state, act_carry, obs, key, mode)
+        if "cache" in act_carry:
+            # incremental decode: one position through the trunk against
+            # the K/V caches; positions > pos in the caches are masked,
+            # so the wrap reset only needs the index (stale K/V rows are
+            # overwritten as the new segment advances)
+            cache, pos = act_carry["cache"], act_carry["pos"]
+            T = cache[0]["k"].shape[1]
+            pos = jnp.where(pos >= T, 0, pos)
+            out_t, cache = self.model.apply(
+                state.params,
+                self._norm_obs(state.obs_stats, obs.astype(jnp.float32)),
+                cache=cache, pos=pos,
+            )
+            action, info = self._head_act(out_t, key, mode)
+            return action, info, {"cache": cache, "pos": pos + 1}
+        buf, pos = act_carry["buf"], act_carry["pos"]
+        T = buf.shape[1]
+        # long eval episodes outrun one segment: re-segment (fresh
+        # context), matching how training segments the stream
+        wrap = pos >= T
+        buf = jnp.where(wrap, jnp.zeros_like(buf), buf)
+        pos = jnp.where(wrap, 0, pos)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, obs.astype(jnp.float32)[:, None], pos, axis=1
+        )
+        # causal attention: position `pos` sees only the 0..pos prefix —
+        # the zero padding at future positions is unread by construction
+        out = self.model.apply(
+            state.params, self._norm_obs(state.obs_stats, buf)
+        )
+        at = lambda x: jax.lax.dynamic_index_in_dim(x, pos, axis=1, keepdims=False)
+        out_t = jax.tree.map(at, out)
+        action, info = self._head_act(out_t, key, mode)
+        return action, info, {"buf": buf, "pos": pos + 1}
+
+
+def build_seq_model(model_config, specs, init_log_std, mesh=None, sp_axis="sp"):
+    """Trajectory actor-critic from ``learner_config.model`` — shared by
+    every learner that supports ``encoder.kind='trajectory'``."""
+    from surreal_tpu.models.attention import (
+        TrajectoryCategoricalPPOModel,
+        TrajectoryPPOModel,
+    )
+
+    if model_config.cnn.enabled:
+        raise ValueError(
+            "model.encoder.kind='trajectory' takes flat vector obs; "
+            "combine it with pixel envs via a CNN feature env wrapper, "
+            "not model.cnn.enabled"
+        )
+    if len(specs.obs.shape) != 1:
+        raise ValueError(
+            "model.encoder.kind='trajectory' needs flat vector obs; got "
+            f"obs shape {specs.obs.shape}"
+        )
+    enc_cfg = model_config.encoder.to_dict()
+    if specs.discrete:
+        return TrajectoryCategoricalPPOModel(
+            encoder_cfg=enc_cfg, n_actions=specs.action.n,
+            mesh=mesh, sp_axis=sp_axis,
+        )
+    return TrajectoryPPOModel(
+        encoder_cfg=enc_cfg,
+        act_dim=int(specs.action.shape[0]),
+        init_log_std=init_log_std,
+        mesh=mesh, sp_axis=sp_axis,
+    )

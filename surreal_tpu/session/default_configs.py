@@ -35,8 +35,8 @@ BASE_LEARNER_CONFIG = Config(
             # 'trajectory' = causal trajectory transformer
             # (models/attention.py) whose attention rides ring attention
             # over an `sp` mesh axis when one is bound — the long-context
-            # seam as a config knob (PPO-family, device envs; other
-            # learners fail fast rather than silently ignore it)
+            # seam as a config knob (on-policy learners: ppo AND impala,
+            # device envs; ddpg fails fast rather than silently ignore it)
             kind="auto",
             features=64,
             num_layers=2,
