@@ -171,3 +171,59 @@ def test_cli_live_actor_and_follow_eval(tmp_path):
     finally:
         trainer.kill()
         trainer.communicate()
+
+
+@pytest.mark.slow
+def test_cli_live_ddpg_actor(tmp_path):
+    """DDPG over the live plane: the published wire view is ACTOR-ONLY
+    (DDPGAgent.acting_view — actor params + obs normalizer, a quarter of
+    the full-state bytes), and the standalone actor drives the stateful
+    OU exploration path end-to-end (remote_act through DDPGAgent.act,
+    with mask_noise_on_reset at episode boundaries)."""
+    folder = tmp_path / "live_ddpg"
+    env, repo = _cli_env()
+    trainer = subprocess.Popen(
+        [
+            sys.executable, "-m", "surreal_tpu", "train", "ddpg",
+            "jax:pendulum", "--folder", str(folder),
+            "--num-envs", "8", "--total-steps", str(10**9),
+            "--set",
+            "session_config.backend=cpu",
+            "learner_config.algo.horizon=8",
+            "learner_config.algo.updates_per_iter=2",
+            "learner_config.algo.exploration.warmup_steps=0",
+            "learner_config.replay.start_sample_size=64",
+            "learner_config.replay.batch_size=64",
+            "learner_config.replay.capacity=4096",
+            "session_config.publish.enabled=true",
+            "session_config.metrics.every_n_iters=1",
+            "session_config.metrics.tensorboard=false",
+            "session_config.metrics.console=false",
+            "session_config.eval.every_n_iters=0",
+            "session_config.checkpoint.every_n_iters=1000000",
+            "env_config.time_limit=50",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo,
+    )
+    try:
+        actor = subprocess.run(
+            [
+                sys.executable, "-m", "surreal_tpu", "actor",
+                "--folder", str(folder), "--episodes", "4",
+                "--num-envs", "2", "--fetch-every", "10",
+                "--min-version", "2", "--max-steps", "2000",
+                "--wait", "240",
+            ],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert actor.returncode == 0, actor.stdout + actor.stderr
+        lines = [json.loads(ln) for ln in actor.stdout.splitlines()]
+        summary = lines[-1]
+        episodes = [ln for ln in lines if "episode" in ln]
+        assert len(episodes) >= 4
+        assert summary["actor/versions_seen"] >= 2, summary
+        assert trainer.poll() is None  # learner alive throughout
+    finally:
+        trainer.kill()
+        trainer.communicate()

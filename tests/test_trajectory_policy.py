@@ -113,7 +113,7 @@ def test_seq_learn_updates_and_is_finite():
     assert changed
 
 
-def test_trajectory_policy_guards():
+def test_trajectory_policy_guards(tmp_path):
     """Drivers that cannot thread the context carry refuse loudly."""
     learner, _ = _seq_learner()
     state = learner.init(jax.random.key(0))
@@ -134,7 +134,7 @@ def test_trajectory_policy_guards():
             model=Config(encoder=Config(kind="trajectory")),
         ),
         env_config=Config(name="gym:CartPole-v1", num_envs=4),
-        session_config=Config(folder="/tmp/seq_guard"),
+        session_config=Config(folder=str(tmp_path)),
     ).extend(base_config())
     with pytest.raises(ValueError, match="device env"):
         Trainer(cfg)
@@ -161,7 +161,7 @@ def test_rebind_mesh_routes_ring_attention():
 
 
 @pytest.mark.slow
-def test_trajectory_ppo_learns_cartpole():
+def test_trajectory_ppo_learns_cartpole(tmp_path):
     """E2E: a small attention policy TRAINS on a device env (the VERDICT
     done-bar for the seam) — late-run episode return clearly above the
     early-run mean."""
@@ -184,7 +184,7 @@ def test_trajectory_ppo_learns_cartpole():
         ),
         env_config=Config(name="jax:cartpole", num_envs=num_envs),
         session_config=Config(
-            folder="/tmp/seq_learns",
+            folder=str(tmp_path),
             total_env_steps=horizon * num_envs * 150,
             metrics=Config(every_n_iters=5, tensorboard=False, console=False),
             checkpoint=Config(every_n_iters=0),
@@ -338,7 +338,7 @@ def test_ddpg_rejects_trajectory_encoder():
         )
 
 
-def test_impala_seq_trains_on_device_env():
+def test_impala_seq_trains_on_device_env(tmp_path):
     """Fused-trainer e2e smoke: IMPALA + trajectory encoder on a device
     env compiles and runs (finite losses, params update)."""
     from surreal_tpu.launch.trainer import Trainer
@@ -353,7 +353,7 @@ def test_impala_seq_trains_on_device_env():
         ),
         env_config=Config(name="jax:cartpole", num_envs=16),
         session_config=Config(
-            folder="/tmp/impala_seq_smoke",
+            folder=str(tmp_path),
             total_env_steps=8 * 16 * 3,
             metrics=Config(every_n_iters=1, tensorboard=False, console=False),
             checkpoint=Config(every_n_iters=0),
