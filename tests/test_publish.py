@@ -227,3 +227,72 @@ def test_cli_live_ddpg_actor(tmp_path):
     finally:
         trainer.kill()
         trainer.communicate()
+
+
+def test_wait_for_publish_rediscovers_rewritten_address(tmp_path):
+    """A dead session's stale param_server.json must not strand a waiting
+    actor: _wait_for_publish re-resolves the discovery file between
+    retries and reconnects when a NEW session rewrites it (the r4 review
+    scenario — old session SIGKILLed, relaunch rewrites the file)."""
+    import threading
+
+    from surreal_tpu.agents import make_agent
+    from surreal_tpu.distributed.param_service import (
+        ParameterPublisher,
+        ParameterServer,
+    )
+    from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+    from surreal_tpu.learners import build_learner
+    from surreal_tpu.main.launch import _wait_for_publish
+
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(3,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(1,), dtype=np.dtype(np.float32)),
+    )
+    learner = build_learner(Config(algo=Config(name="ppo")), specs)
+    state = learner.init(jax.random.key(0))
+
+    # stale advertisement: nothing listens on this port
+    stale = "tcp://127.0.0.1:1"
+    path = tmp_path / "param_server.json"
+    path.write_text(json.dumps({"addresses": [stale], "publisher": "x"}))
+
+    agent = make_agent(learner)
+    agent.connect(stale, state, fetch_every=1)
+
+    # a "new session" comes up 1s later and rewrites the discovery file
+    pub = ParameterPublisher()
+    srv = ParameterServer(pub.address)
+
+    relaunch_errors: list = []
+
+    def relaunch():
+        try:
+            time.sleep(1.0)
+            tmp = str(path) + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"addresses": [srv.address], "publisher": pub.address}, f
+                )
+            os.replace(tmp, str(path))
+            time.sleep(0.3)
+            pub.publish(agent.acting_view(state))
+        except BaseException as e:  # surface in the main thread, not as
+            relaunch_errors.append(e)  # a misleading 30s timeout
+            raise
+
+    t = threading.Thread(target=relaunch)
+    t.start()
+    try:
+        ok = _wait_for_publish(
+            agent, str(tmp_path), connect=None, address=stale, wait_s=30
+        )
+        t.join()
+        assert not relaunch_errors, relaunch_errors
+        assert ok, "actor never recovered from the stale address"
+        assert agent.param_version >= 1
+    finally:
+        t.join()
+        agent.close()
+        srv.close()
+        pub.close()
