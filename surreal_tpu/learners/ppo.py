@@ -129,7 +129,8 @@ class PPOLearner(SequenceActingMixin, Learner):
         self.requires_act_carry = self.seq_policy
         if self.seq_policy:
             self.model = build_seq_model(
-                learner_config.model, env_specs, algo.init_log_std
+                learner_config.model, env_specs, algo.init_log_std,
+                horizon=algo.horizon,
             )
         elif self.discrete:
             self.model = CategoricalPPOModel(
@@ -194,9 +195,9 @@ class PPOLearner(SequenceActingMixin, Learner):
         if self.seq_policy:
             raise RuntimeError(
                 "trajectory policies condition on history: act through "
-                "act_init/act_step (the device collectors and evaluator "
-                "do); host SEED planes and remote actors do not support "
-                "model.encoder.kind='trajectory'"
+                "act_init/act_step (the device collectors, evaluator, and "
+                "remote Agent.remote_act do); the stateless act() has no "
+                "context to condition on"
             )
         out = self.model.apply(
             state.params, self._norm_obs(state.obs_stats, obs)

@@ -65,6 +65,7 @@ class SequenceActingMixin(PolicyHeadMixin):
             self.model = build_seq_model(
                 self.config.model, self.specs,
                 self.config.algo.init_log_std, mesh=mesh, sp_axis=sp_axis,
+                horizon=self.config.algo.horizon,
             )
 
     # -- sequence acting (model.encoder.kind='trajectory') -------------------
@@ -139,14 +140,26 @@ class SequenceActingMixin(PolicyHeadMixin):
         return action, info, {"buf": buf, "pos": pos + 1}
 
 
-def build_seq_model(model_config, specs, init_log_std, mesh=None, sp_axis="sp"):
+def build_seq_model(
+    model_config, specs, init_log_std, mesh=None, sp_axis="sp", horizon=None
+):
     """Trajectory actor-critic from ``learner_config.model`` — shared by
-    every learner that supports ``encoder.kind='trajectory'``."""
+    every learner that supports ``encoder.kind='trajectory'``. ``horizon``
+    (algo.horizon, when the caller has it) is validated against
+    ``encoder.max_len``: the extended learn pass runs T+1 positions, so
+    pos_embed must cover horizon+1."""
     from surreal_tpu.models.attention import (
         TrajectoryCategoricalPPOModel,
         TrajectoryPPOModel,
     )
 
+    max_len = int(model_config.encoder.get("max_len", 4096))
+    if horizon is not None and int(horizon) + 1 > max_len:
+        raise ValueError(
+            f"algo.horizon={int(horizon)} needs model.encoder.max_len >= "
+            f"{int(horizon) + 1} (the sequence learn pass extends the "
+            f"segment by one bootstrap position); got max_len={max_len}"
+        )
     if model_config.cnn.enabled:
         raise ValueError(
             "model.encoder.kind='trajectory' takes flat vector obs; "

@@ -174,6 +174,25 @@ def _run_local_group(args) -> int:
     Rank 0 inherits this terminal; ranks > 0 log to <folder>/rank<i>.log.
     A non-zero child exit tears the whole group down (a half-dead process
     group would deadlock the survivors' next collective)."""
+    # Picking the coordinator port by bind-then-close is a TOCTOU race:
+    # another process can grab it before rank 0 binds. One retry with a
+    # fresh port (when the group dies inside the startup window) makes
+    # the race a non-event instead of a failed launch.
+    code = _spawn_local_group_once(args, retry_early_failure=True)
+    if code == _EARLY_GROUP_FAILURE:
+        print(
+            "local group failed during startup (coordinator port race?); "
+            "retrying once with a fresh port",
+            file=sys.stderr,
+        )
+        code = _spawn_local_group_once(args, retry_early_failure=False)
+    return code
+
+
+_EARLY_GROUP_FAILURE = -255  # sentinel: group died inside the startup window
+
+
+def _spawn_local_group_once(args, retry_early_failure: bool) -> int:
     import signal
     import socket
     import subprocess
@@ -186,6 +205,7 @@ def _run_local_group(args) -> int:
     child_argv = _strip_local_procs(args.raw_argv)
     os.makedirs(args.folder, exist_ok=True)
     procs, logs = [], []
+    start = time.monotonic()
     try:
         for i in range(n):
             if i == 0:
@@ -222,6 +242,15 @@ def _run_local_group(args) -> int:
                             time.sleep(0.1)
                         if p.poll() is None:
                             p.kill()
+                    # retry only plausible port races: a child that died
+                    # from a signal (bad < 0, e.g. the user's Ctrl+C
+                    # forwarded to the group) must not respawn the group
+                    if (
+                        retry_early_failure
+                        and bad > 0
+                        and time.monotonic() - start < 15
+                    ):
+                        return _EARLY_GROUP_FAILURE
                     return int(bad)
                 if all(c == 0 for c in codes):
                     return 0

@@ -176,6 +176,7 @@ class TrajectoryPPOModel(nn.Module):
         trunk = TrajectoryEncoder(
             features=cfg["features"], num_layers=cfg["num_layers"],
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
+            max_len=int(cfg.get("max_len", 4096)),
             mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
@@ -220,6 +221,7 @@ class TrajectoryCategoricalPPOModel(nn.Module):
         trunk = TrajectoryEncoder(
             features=cfg["features"], num_layers=cfg["num_layers"],
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
+            max_len=int(cfg.get("max_len", 4096)),
             mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]

@@ -46,6 +46,9 @@ BASE_LEARNER_CONFIG = Config(
             # cache — O(T) per step) | 'padded' (re-run the full padded
             # segment each step — O(T^2), the simple reference form)
             act_impl="kv",
+            # pos_embed capacity; the sequence learn pass uses horizon+1
+            # positions, validated at learner build (seq_policy.py)
+            max_len=4096,
         ),
         cnn=Config(
             enabled=False,          # pixel observations -> Nature-CNN stem

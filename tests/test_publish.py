@@ -296,3 +296,53 @@ def test_wait_for_publish_rediscovers_rewritten_address(tmp_path):
         agent.close()
         srv.close()
         pub.close()
+
+
+@pytest.mark.slow
+def test_cli_live_trajectory_actor(tmp_path):
+    """Round-5 VERDICT item 5 done-bar: a TRAJECTORY policy
+    (model.encoder.kind='trajectory') acts over the live plane — the
+    standalone actor carries its K/V context client-side, finishes
+    episodes with finite returns, and tracks the live learner's versions
+    (context persists across fetches; agents/base.py::remote_act)."""
+    folder = tmp_path / "live_traj"
+    env, repo = _cli_env()
+    traj_set = _SET_COMMON + [
+        "learner_config.model.encoder.kind=trajectory",
+        "learner_config.model.encoder.features=32",
+        "learner_config.model.encoder.num_layers=1",
+        "learner_config.model.encoder.num_heads=2",
+        "learner_config.model.encoder.head_dim=8",
+    ]
+    trainer = subprocess.Popen(
+        [
+            sys.executable, "-m", "surreal_tpu", "train", "ppo",
+            "jax:pendulum", "--folder", str(folder),
+            "--num-envs", "8", "--total-steps", str(10**9),
+            "--set", *traj_set,
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo,
+    )
+    try:
+        actor = subprocess.run(
+            [
+                sys.executable, "-m", "surreal_tpu", "actor",
+                "--folder", str(folder), "--episodes", "3",
+                "--num-envs", "2", "--fetch-every", "10",
+                "--min-version", "2",
+                "--max-steps", "2000", "--wait", "240",
+            ],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert actor.returncode == 0, actor.stdout + actor.stderr
+        lines = [json.loads(ln) for ln in actor.stdout.splitlines()]
+        summary = lines[-1]
+        episodes = [ln for ln in lines if "episode" in ln]
+        assert episodes, actor.stdout
+        assert all(np.isfinite(ep["return"]) for ep in episodes)
+        assert summary["actor/versions_seen"] >= 2, summary
+        assert trainer.poll() is None
+    finally:
+        trainer.kill()
+        trainer.communicate()

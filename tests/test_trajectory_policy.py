@@ -120,11 +120,15 @@ def test_trajectory_policy_guards(tmp_path):
     with pytest.raises(RuntimeError, match="act_init/act_step"):
         learner.act(state, jnp.zeros((2, 5)), jax.random.key(1))
 
+    # remote actors SUPPORT trajectory policies since round 5 (the carry
+    # lives client-side — tests/test_agents.py covers the acting path);
+    # connect must therefore no longer reject them
     from surreal_tpu.agents import make_agent
 
     agent = make_agent(learner)
-    with pytest.raises(ValueError, match="remote actors"):
-        agent.connect("tcp://127.0.0.1:1", state)
+    agent.connect("tcp://127.0.0.1:1", state)
+    assert agent._client is not None
+    agent.close()
 
     from surreal_tpu.launch.trainer import Trainer
 
@@ -138,6 +142,16 @@ def test_trajectory_policy_guards(tmp_path):
     ).extend(base_config())
     with pytest.raises(ValueError, match="device env"):
         Trainer(cfg)
+
+    # the SEED plane stays a deliberate fail-fast (async worker slices vs
+    # lockstep segment carry — design note in launch/seed_trainer.py)
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    seed_cfg = Config(
+        session_config=Config(topology=Config(num_env_workers=1)),
+    ).extend(cfg)
+    with pytest.raises(ValueError, match="SEED inference server"):
+        SEEDTrainer(seed_cfg)
 
 
 def test_rebind_mesh_routes_ring_attention():
