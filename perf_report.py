@@ -994,10 +994,17 @@ def main(argv=None) -> None:
                 "bottleneck at larger batch."
                 if dominant[0] == "env rendering+logic"
                 else
-                "The conv path owns the iteration at this geometry, so "
-                "kernel-level work (bf16 conv stem, channel-padded "
-                "layouts, or a fused pallas stem) IS the available lever "
-                "— revisit before scaling pixel workloads further."
+                "The conv path owns the iteration at this geometry. "
+                "Decision recorded after checking the stem: it already "
+                "computes in bf16 (models/encoders.py NatureCNN), so the "
+                "remaining kernel levers are channel-padded layouts or a "
+                "fused pallas stem — NOT pursued, because the low MFU is "
+                "structural at this shape (the first conv's C_in=2 "
+                "underfills the 128-lane MXU regardless of kernel, and "
+                "XLA already pads); a pallas conv would re-derive XLA's "
+                "own schedule for single-digit-ms stakes. Revisit only "
+                "if pixel workloads scale to larger frames/channels "
+                "where the conv becomes tens of ms."
             ),
         ]
     bvr = _load_block_vs_row()
@@ -1012,7 +1019,12 @@ def main(argv=None) -> None:
             "",
             f"Geometry {s['geometry']}, {s['n_iters']} iterations per run, "
             f"{len(s['block']['final_returns'])} seeds per arm, arms "
-            "interleaved (perf_curves.py; artifact `block_vs_row.json`).",
+            "interleaved (perf_curves.py; artifact `block_vs_row.json`"
+            + (
+                f"; final performance = {s['final_estimator']}"
+                if s.get("final_estimator") else ""
+            )
+            + ").",
             "",
             "| Shuffle mode | final returns (per seed, sorted) | median |",
             "|---|---|---|",
@@ -1251,8 +1263,8 @@ def _update_readme(rows) -> None:
         "",
         f"_Table generated by `perf_report.py` (device_get-fenced, this "
         f"run's measurements; headline iter {head['iter_ms']:.1f} ms, "
-        f"MFU {head.get('mfu', 0) * 100:.2f}%).{art_txt} Full breakdown, "
-        "learn-phase attribution, and geometry sweep: `PERF.md`._",
+        f"MFU {head.get('mfu', 0) * 100:.2f}%).{art_txt} Full breakdown "
+        "and per-phase attributions: `PERF.md`._",
     ]
     new = (
         readme[: readme.index(start) + len(start)]

@@ -342,6 +342,22 @@ def test_cli_live_trajectory_actor(tmp_path):
         assert episodes, actor.stdout
         assert all(np.isfinite(ep["return"]) for ep in episodes)
         assert summary["actor/versions_seen"] >= 2, summary
+
+        # standing eval against the same live session: the Evaluator
+        # drives act_init/act_step itself, so --follow needs only the
+        # connect() unblock — score rounds must flow with finite returns
+        follow = subprocess.run(
+            [
+                sys.executable, "-m", "surreal_tpu", "eval",
+                "--folder", str(folder), "--follow", "--rounds", "2",
+                "--episodes", "2", "--wait", "120",
+            ],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        assert follow.returncode == 0, follow.stdout + follow.stderr
+        rounds = [json.loads(ln) for ln in follow.stdout.splitlines()]
+        assert len(rounds) == 2
+        assert all(np.isfinite(r["eval/return"]) for r in rounds)
         assert trainer.poll() is None
     finally:
         trainer.kill()
