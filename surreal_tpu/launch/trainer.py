@@ -53,7 +53,39 @@ class Trainer:
             from surreal_tpu.parallel.mesh import make_mesh
 
             self.mesh = make_mesh(topo)
-            if self.mesh.size > 1:
+            sp = dict(self.mesh.shape).get("sp", 1)
+            if sp > 1:
+                # sequence-parallel fused trainer (SURVEY.md §5.7 long-
+                # context seam as a TOPOLOGY knob): the trajectory
+                # policy's full-segment attention rides ring attention
+                # over mesh['sp'] (ops/ring_attention.py — K/V blocks
+                # rotate via ppermute, online softmax), dividing the
+                # quadratic attention FLOPs and the [T, T] score memory
+                # across devices. Non-attention compute (env scan, MLP
+                # blocks, optimizer) replicates — the sp axis targets the
+                # long-horizon regime where attention dominates. The
+                # outer step is a plain jit: ring attention brings its
+                # own shard_map, and nesting it inside the dp shard_map
+                # would rebind the same mesh — hence the dp==1 guard.
+                if not getattr(self.learner, "requires_act_carry", False):
+                    raise ValueError(
+                        "topology.mesh sp>1 shards trajectory attention; "
+                        "it requires model.encoder.kind='trajectory' "
+                        "(memoryless policies have no sequence axis to "
+                        "shard — use the dp axis instead)"
+                    )
+                if dict(self.mesh.shape).get("dp", 1) > 1:
+                    raise ValueError(
+                        "topology.mesh with BOTH dp>1 and sp>1 is not "
+                        "supported by the fused trainer yet: ring "
+                        "attention runs its own shard_map over the mesh "
+                        "and cannot nest inside the dp shard_map. Use "
+                        "dp=1 with sp=N (long-context) or sp=1 with dp=N "
+                        "(throughput)."
+                    )
+                self.learner.rebind_mesh(self.mesh, "sp")
+                self._train_iter = jax.jit(self._device_train_iter)
+            elif self.mesh.size > 1:
                 from surreal_tpu.parallel.dp import dp_train_iter
                 from surreal_tpu.parallel.mesh import check_dp_divisible
 

@@ -73,9 +73,24 @@ class CausalSelfAttention(nn.Module):
             return out_proj(out.reshape(B, H * D)), {"k": k_cache, "v": v_cache}
         B, T, _ = x.shape
         if self.mesh is not None:
+            # ring attention shards T over mesh[sp_axis]; pad T up to the
+            # next multiple with zero rows at the END. Under the causal
+            # mask no real query position attends a pad key (pads sit at
+            # the highest positions), so the sliced-back output is exact
+            # — this is what lets the learn pass run its T+1 extended
+            # segment (bootstrap position) through the ring.
+            sp = self.mesh.shape[self.sp_axis]
+            pad = (-T) % sp
+            if pad:
+                zeros = jnp.zeros((B, pad, H, D), q.dtype)
+                q_, k_, v_ = (
+                    jnp.concatenate([a, zeros], axis=1) for a in (q, k, v)
+                )
+            else:
+                q_, k_, v_ = q, k, v
             out = ring_self_attention(
-                self.mesh, q, k, v, causal=True, axis=self.sp_axis
-            )
+                self.mesh, q_, k_, v_, causal=True, axis=self.sp_axis
+            )[:, :T]
         else:
             out = full_attention(q, k, v, causal=True)
         return out_proj(out.reshape(B, T, H * D))
