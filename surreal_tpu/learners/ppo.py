@@ -525,13 +525,14 @@ class PPOLearner(SequenceActingMixin, Learner):
             )
         else:
             obs_stats = state.obs_stats
-        # [T, B, ...] -> [B, T, ...]: the encoder is batch-major
+        # [T, B, ...] -> [B, T, ...]: the encoder is batch-major. Obs
+        # dtype discipline lives in ONE place — the trajectory models'
+        # _obs_dtype (uint8 pixels stay raw into the CNN stem's /255;
+        # _norm_obs casts vector obs to f32 when the ZFilter is on).
         obs_bt = jnp.swapaxes(
-            self._norm_obs(obs_stats, batch["obs"].astype(jnp.float32)), 0, 1
+            self._norm_obs(obs_stats, batch["obs"]), 0, 1
         )
-        last_next = self._norm_obs(
-            obs_stats, batch["next_obs"][-1].astype(jnp.float32)
-        )
+        last_next = self._norm_obs(obs_stats, batch["next_obs"][-1])
         ext = jnp.concatenate([obs_bt, last_next[:, None]], axis=1)
         out_ext = self.model.apply(state.params, ext)   # [B, T+1, ...]
         values = out_ext.value[:, :T].swapaxes(0, 1)    # [T, B]

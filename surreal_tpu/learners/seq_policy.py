@@ -79,9 +79,18 @@ class SequenceActingMixin(PolicyHeadMixin):
         enc = self.config.model.encoder
         T = int(self.config.algo.horizon)
         if enc.get("act_impl", "kv") == "padded":
+            # pixels buffer as uint8 (the trajectory models keep uint8
+            # raw into the CNN stem's /255); vector obs buffer in f32
+            import numpy as np
+
+            buf_dtype = (
+                jnp.uint8
+                if self.specs.obs.dtype == np.uint8
+                else jnp.float32
+            )
             return {
                 "buf": jnp.zeros(
-                    (num_envs, T, *self.specs.obs.shape), jnp.float32
+                    (num_envs, T, *self.specs.obs.shape), buf_dtype
                 ),
                 "pos": jnp.zeros((), jnp.int32),
             }
@@ -114,7 +123,7 @@ class SequenceActingMixin(PolicyHeadMixin):
             pos = jnp.where(pos >= T, 0, pos)
             out_t, cache = self.model.apply(
                 state.params,
-                self._norm_obs(state.obs_stats, obs.astype(jnp.float32)),
+                self._norm_obs(state.obs_stats, obs),
                 cache=cache, pos=pos,
             )
             action, info = self._head_act(out_t, key, mode)
@@ -127,7 +136,7 @@ class SequenceActingMixin(PolicyHeadMixin):
         buf = jnp.where(wrap, jnp.zeros_like(buf), buf)
         pos = jnp.where(wrap, 0, pos)
         buf = jax.lax.dynamic_update_slice_in_dim(
-            buf, obs.astype(jnp.float32)[:, None], pos, axis=1
+            buf, obs.astype(buf.dtype)[:, None], pos, axis=1
         )
         # causal attention: position `pos` sees only the 0..pos prefix —
         # the zero padding at future positions is unread by construction
@@ -160,26 +169,32 @@ def build_seq_model(
             f"{int(horizon) + 1} (the sequence learn pass extends the "
             f"segment by one bootstrap position); got max_len={max_len}"
         )
+    cnn_cfg = None
     if model_config.cnn.enabled:
+        # PIXEL trajectories (round 5): a NatureCNN stem embeds each
+        # frame per position before the causal attention — long-context
+        # policies over pixel envs, not just vector obs
+        if len(specs.obs.shape) != 3:
+            raise ValueError(
+                "model.encoder.kind='trajectory' with model.cnn.enabled "
+                f"needs [H, W, C] pixel obs; got shape {specs.obs.shape}"
+            )
+        cnn_cfg = model_config.cnn.to_dict()
+    elif len(specs.obs.shape) != 1:
         raise ValueError(
-            "model.encoder.kind='trajectory' takes flat vector obs; "
-            "combine it with pixel envs via a CNN feature env wrapper, "
-            "not model.cnn.enabled"
-        )
-    if len(specs.obs.shape) != 1:
-        raise ValueError(
-            "model.encoder.kind='trajectory' needs flat vector obs; got "
-            f"obs shape {specs.obs.shape}"
+            "model.encoder.kind='trajectory' needs flat vector obs (or "
+            "model.cnn.enabled for [H, W, C] pixels); got obs shape "
+            f"{specs.obs.shape}"
         )
     enc_cfg = model_config.encoder.to_dict()
     if specs.discrete:
         return TrajectoryCategoricalPPOModel(
             encoder_cfg=enc_cfg, n_actions=specs.action.n,
-            mesh=mesh, sp_axis=sp_axis,
+            mesh=mesh, sp_axis=sp_axis, cnn_cfg=cnn_cfg,
         )
     return TrajectoryPPOModel(
         encoder_cfg=enc_cfg,
         act_dim=int(specs.action.shape[0]),
         init_log_std=init_log_std,
-        mesh=mesh, sp_axis=sp_axis,
+        mesh=mesh, sp_axis=sp_axis, cnn_cfg=cnn_cfg,
     )

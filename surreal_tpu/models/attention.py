@@ -98,7 +98,13 @@ class CausalSelfAttention(nn.Module):
 
 class TrajectoryEncoder(nn.Module):
     """Small pre-LN causal transformer over a trajectory: [B, T, obs] ->
-    [B, T, features]. Heads (policy/value) attach outside."""
+    [B, T, features]. Heads (policy/value) attach outside.
+
+    With ``cnn_cfg`` set (pixel trajectories: obs [B, T, H, W, C]), each
+    frame runs through a NatureCNN stem per position before the embed —
+    the long-context seam over PIXEL envs. uint8 frames are scaled /255
+    inside the stem, so callers keep pixels as compact uint8 end to end.
+    """
 
     features: int = 64
     num_layers: int = 2
@@ -107,6 +113,7 @@ class TrajectoryEncoder(nn.Module):
     mesh: Any = None
     sp_axis: str = "sp"
     max_len: int = 4096
+    cnn_cfg: Any = None  # model.cnn subtree as a plain dict, or None
     compute_dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -127,6 +134,20 @@ class TrajectoryEncoder(nn.Module):
             (self.max_len, self.features),
             self.param_dtype,
         )
+        if self.cnn_cfg:
+            from surreal_tpu.models.encoders import cnn_from_config
+
+            stem = cnn_from_config(
+                self.cnn_cfg, self.compute_dtype, self.param_dtype,
+                name="cnn_stem",
+            )
+            if decode:
+                obs = stem(obs)  # [B, H, W, C] -> [B, dense]
+            else:
+                B_, T_ = obs.shape[:2]
+                obs = stem(
+                    obs.reshape(B_ * T_, *obs.shape[2:])
+                ).reshape(B_, T_, -1)
         x = embed(obs.astype(self.compute_dtype))
         if decode:
             x = x + jax.lax.dynamic_index_in_dim(
@@ -169,6 +190,14 @@ class TrajectoryEncoder(nn.Module):
         return (out, new_cache) if decode else out
 
 
+def _obs_dtype(obs):
+    """THE obs-dtype rule for trajectory models (single owner — learners
+    pass obs through untouched): uint8 pixels stay uint8 into the trunk
+    (the CNN stem scales /255 on device, keeping bytes compact through
+    transfers); everything else runs in f32."""
+    return obs if obs.dtype == jnp.uint8 else obs.astype(jnp.float32)
+
+
 class TrajectoryPPOModel(nn.Module):
     """Sequence actor-critic (continuous): [B, T, obs] -> PolicyOutput
     with [B, T] leading dims; every position conditions causally on the
@@ -182,6 +211,7 @@ class TrajectoryPPOModel(nn.Module):
     init_log_std: float = -0.5
     mesh: Any = None    # set via Learner.rebind_mesh for sp>1 topologies
     sp_axis: str = "sp"
+    cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None):
@@ -192,14 +222,13 @@ class TrajectoryPPOModel(nn.Module):
             features=cfg["features"], num_layers=cfg["num_layers"],
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
             max_len=int(cfg.get("max_len", 4096)),
+            cnn_cfg=self.cnn_cfg,
             mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
-            h, new_cache = trunk(
-                obs_seq.astype(jnp.float32), cache=cache, pos=pos
-            )
+            h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)
         else:
-            h = trunk(obs_seq.astype(jnp.float32))
+            h = trunk(_obs_dtype(obs_seq))
         mean = nn.Dense(
             self.act_dim, kernel_init=orthogonal_init(0.01),
             param_dtype=jnp.float32, name="mean",
@@ -227,6 +256,7 @@ class TrajectoryCategoricalPPOModel(nn.Module):
     n_actions: int
     mesh: Any = None
     sp_axis: str = "sp"
+    cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None):
@@ -237,14 +267,13 @@ class TrajectoryCategoricalPPOModel(nn.Module):
             features=cfg["features"], num_layers=cfg["num_layers"],
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
             max_len=int(cfg.get("max_len", 4096)),
+            cnn_cfg=self.cnn_cfg,
             mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
-            h, new_cache = trunk(
-                obs_seq.astype(jnp.float32), cache=cache, pos=pos
-            )
+            h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)
         else:
-            h = trunk(obs_seq.astype(jnp.float32))
+            h = trunk(_obs_dtype(obs_seq))
         logits = nn.Dense(
             self.n_actions, kernel_init=orthogonal_init(0.01),
             param_dtype=jnp.float32, name="logits",

@@ -104,6 +104,22 @@ class NatureCNN(nn.Module):
         return act(x)
 
 
+def cnn_from_config(cnn_cfg, compute_dtype, param_dtype, name=None) -> NatureCNN:
+    """The one NatureCNN-from-``model.cnn``-subtree constructor — shared
+    by the memoryless trunk and the trajectory encoder's per-frame stem,
+    so a new cnn config key cannot be honored by one and dropped by the
+    other."""
+    return NatureCNN(
+        channels=tuple(cnn_cfg["channels"]),
+        kernels=tuple(cnn_cfg["kernels"]),
+        strides=tuple(cnn_cfg["strides"]),
+        dense=cnn_cfg["dense"],
+        compute_dtype=compute_dtype,
+        param_dtype=param_dtype,
+        name=name,
+    )
+
+
 def make_trunk(model_cfg, hidden: Sequence[int]) -> nn.Module:
     """Build the obs trunk from a ``learner_config.model`` subtree: CNN stem
     for pixel obs, MLP otherwise.
@@ -115,14 +131,7 @@ def make_trunk(model_cfg, hidden: Sequence[int]) -> nn.Module:
     param_dtype = jnp.dtype(model_cfg["dtype"])
     cnn = model_cfg["cnn"]
     if cnn["enabled"]:
-        return NatureCNN(
-            channels=tuple(cnn["channels"]),
-            kernels=tuple(cnn["kernels"]),
-            strides=tuple(cnn["strides"]),
-            dense=cnn["dense"],
-            compute_dtype=compute_dtype,
-            param_dtype=param_dtype,
-        )
+        return cnn_from_config(cnn, compute_dtype, param_dtype)
     return MLP(
         hidden=tuple(hidden),
         activation=model_cfg["activation"],

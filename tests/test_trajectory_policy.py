@@ -474,3 +474,121 @@ def test_sp_fused_trainer_guards(tmp_path):
     ).extend(cfg2)
     with pytest.raises(ValueError, match="dp>1 and sp>1"):
         Trainer(cfg2)
+
+
+def _pixel_seq_cfg(folder, horizon=8, num_envs=8, iters=2):
+    return Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=horizon, epochs=1,
+                        num_minibatches=1),
+            model=Config(
+                cnn=Config(enabled=True, channels=(8, 16), kernels=(4, 3),
+                           strides=(2, 1), dense=32),
+                encoder=Config(kind="trajectory", features=32, num_layers=1,
+                               num_heads=2, head_dim=8),
+            ),
+        ),
+        env_config=Config(name="jax:pong16", num_envs=num_envs,
+                          time_limit=128),
+        session_config=Config(
+            folder=folder,
+            total_env_steps=horizon * num_envs * iters,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+
+
+def _pixel_seq_learner(horizon=8):
+    from surreal_tpu.envs import make_env
+
+    # learner construction reads no session folder; any string works
+    cfg = _pixel_seq_cfg("/unused", horizon=horizon)
+    env = make_env(cfg.env_config)
+    return build_learner(cfg.learner_config, env.specs), env.specs
+
+
+def test_pixel_trajectory_kv_matches_padded():
+    """PIXEL trajectories (round 5): a NatureCNN stem embeds each frame
+    before the causal attention. The KV decode path must reproduce the
+    padded path position by position on uint8 frames — including that
+    both keep pixels uint8 into the stem (a silently-f32 path would skip
+    the /255 scaling and the two would diverge)."""
+    T, B = 6, 3
+    learner, specs = _pixel_seq_learner(horizon=T)
+    state = learner.init(jax.random.key(0))
+    obs_seq = jax.random.randint(
+        jax.random.key(1), (T, B, *specs.obs.shape), 0, 255, dtype=jnp.int32
+    ).astype(jnp.uint8)
+
+    import copy
+
+    kv_learner = learner
+    padded_learner, _ = _pixel_seq_learner(horizon=T)
+    padded_learner.config = copy.deepcopy(padded_learner.config)
+    padded_learner.config.model.encoder.act_impl = "padded"
+
+    kv_carry = kv_learner.act_init(B)
+    pad_carry = padded_learner.act_init(B)
+    assert "cache" in kv_carry and "buf" in pad_carry
+    assert pad_carry["buf"].dtype == jnp.uint8  # pixels buffer raw
+    for t in range(T):
+        a_kv, i_kv, kv_carry = kv_learner.act_step(
+            state, kv_carry, obs_seq[t], jax.random.key(100 + t),
+            "eval_deterministic",
+        )
+        a_pad, i_pad, pad_carry = padded_learner.act_step(
+            state, pad_carry, obs_seq[t], jax.random.key(100 + t),
+            "eval_deterministic",
+        )
+        np.testing.assert_array_equal(np.asarray(a_kv), np.asarray(a_pad))
+        np.testing.assert_allclose(
+            np.asarray(i_kv["logp"]), np.asarray(i_pad["logp"]),
+            atol=3e-2, rtol=3e-2,
+        )
+
+
+def test_pixel_trajectory_fused_trainer_runs(tmp_path):
+    """The fused device trainer drives a pixel-trajectory policy end to
+    end (render -> per-frame CNN stem -> causal attention -> learn):
+    metrics finite, params update."""
+    from surreal_tpu.launch.trainer import Trainer
+
+    trainer = Trainer(_pixel_seq_cfg(str(tmp_path), iters=2))
+    assert trainer.learner.seq_policy
+    _, metrics = trainer.run()
+    for k in ("loss/pg", "loss/value"):
+        assert np.isfinite(metrics[k]), (k, metrics)
+
+
+@pytest.mark.slow
+def test_pixel_trajectory_ppo_learns_pong16(tmp_path):
+    """Pixel-LEARNING guard for the trajectory seam: the on-device
+    render -> per-frame CNN stem -> causal attention -> learn path must
+    IMPROVE the policy on 16x16 pong, mirroring the memoryless CNN guard
+    (tests/test_envs.py::test_ppo_cnn_learns_on_pong16_pixels)."""
+    from surreal_tpu.launch.trainer import Trainer
+
+    horizon, num_envs = 16, 32
+    cfg = _pixel_seq_cfg(str(tmp_path), horizon=horizon,
+                         num_envs=num_envs, iters=400)
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(epochs=2, num_minibatches=2, entropy_coeff=0.01),
+            optimizer=Config(lr=1e-3),
+        ),
+        session_config=Config(metrics=Config(every_n_iters=10)),
+    ).extend(cfg)
+    returns = []
+
+    def on_metrics(iteration, m):
+        r = m.get("episode/return")
+        if r is not None and np.isfinite(r):
+            returns.append(float(r))
+
+    Trainer(cfg).run(on_metrics=on_metrics)
+    assert len(returns) >= 8, f"too few completed-episode samples: {returns}"
+    early = float(np.mean(returns[:3]))
+    late = float(np.max(returns[-4:]))
+    assert late > early + 1.5, (early, late, returns)
