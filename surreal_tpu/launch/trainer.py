@@ -61,12 +61,15 @@ class Trainer:
                 # over mesh['sp'] (ops/ring_attention.py — K/V blocks
                 # rotate via ppermute, online softmax), dividing the
                 # quadratic attention FLOPs and the [T, T] score memory
-                # across devices. Non-attention compute (env scan, MLP
-                # blocks, optimizer) replicates — the sp axis targets the
-                # long-horizon regime where attention dominates. The
-                # outer step is a plain jit: ring attention brings its
-                # own shard_map, and nesting it inside the dp shard_map
-                # would rebind the same mesh — hence the dp==1 guard.
+                # across devices. The outer step is a plain jit: ring
+                # attention brings its own shard_map (which cannot nest
+                # inside the dp shard_map — it would rebind the same
+                # mesh), so a composed dp x sp mesh instead shards the
+                # ring over BOTH axes and lets GSPMD propagate/reduce
+                # the rest of the step from the dp-sharded env carry.
+                # With dp=1, non-attention compute replicates — the sp
+                # axis targets the long-horizon regime where attention
+                # dominates.
                 if not getattr(self.learner, "requires_act_carry", False):
                     raise ValueError(
                         "topology.mesh sp>1 shards trajectory attention; "
@@ -74,16 +77,36 @@ class Trainer:
                         "(memoryless policies have no sequence axis to "
                         "shard — use the dp axis instead)"
                     )
-                if dict(self.mesh.shape).get("dp", 1) > 1:
-                    raise ValueError(
-                        "topology.mesh with BOTH dp>1 and sp>1 is not "
-                        "supported by the fused trainer yet: ring "
-                        "attention runs its own shard_map over the mesh "
-                        "and cannot nest inside the dp shard_map. Use "
-                        "dp=1 with sp=N (long-context) or sp=1 with dp=N "
-                        "(throughput)."
+                dp = dict(self.mesh.shape).get("dp", 1)
+                self._sp_carry_sharding = None
+                if dp > 1:
+                    # dp x sp composed mesh: the ring's shard_map tiles
+                    # BOTH axes (batch over dp, time over sp — attention
+                    # rows are independent in B, so the ring body is
+                    # unchanged); the env batch is committed dp-sharded
+                    # at carry init and GSPMD propagates/reduces the
+                    # rest of the (plain-jit) step globally
+                    from surreal_tpu.parallel.mesh import (
+                        batch_sharded,
+                        check_dp_divisible,
                     )
-                self.learner.rebind_mesh(self.mesh, "sp")
+
+                    check_dp_divisible(self.num_envs, dp)
+                    # PPO slices env-wise minibatches; each slice is the
+                    # ring's batch-axis tile. IMPALA consumes the whole
+                    # batch per update (no num_minibatches key) — the
+                    # full-batch check above is the binding one there.
+                    mb = self.learner.config.algo.get("num_minibatches", 1)
+                    check_dp_divisible(
+                        self.num_envs // mb, dp,
+                        what="num_envs/num_minibatches (the ring's "
+                             "batch-axis tile)",
+                        divisor="mesh dp",
+                    )
+                    self.learner.rebind_mesh(self.mesh, "sp", batch_axis="dp")
+                    self._sp_carry_sharding = batch_sharded(self.mesh, "dp")
+                else:
+                    self.learner.rebind_mesh(self.mesh, "sp")
                 self._train_iter = jax.jit(self._device_train_iter)
             elif self.mesh.size > 1:
                 from surreal_tpu.parallel.dp import dp_train_iter
@@ -171,6 +194,11 @@ class Trainer:
 
             if self.device_mode:
                 carry = init_device_carry(self.env, env_key, self.num_envs)
+                if getattr(self, "_sp_carry_sharding", None) is not None:
+                    # dp x sp path: commit the env batch dp-sharded (all
+                    # carry leaves lead with the env dim) so rollout work
+                    # splits over dp instead of replicating
+                    carry = jax.device_put(carry, self._sp_carry_sharding)
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
                     state, carry, metrics = self._train_iter(state, carry, it_key)

@@ -56,16 +56,18 @@ class PolicyHeadMixin:
 
 
 class SequenceActingMixin(PolicyHeadMixin):
-    def rebind_mesh(self, mesh, sp_axis: str = "sp") -> None:
+    def rebind_mesh(self, mesh, sp_axis: str = "sp", batch_axis=None) -> None:
         """Route the trajectory encoder's attention through the ring over
         ``mesh[sp_axis]`` (ops/ring_attention.py) — params are unchanged
         (same module tree, different attention schedule), so this is safe
-        after ``init``/restore. No-op for memoryless policies."""
+        after ``init``/restore. ``batch_axis`` additionally shards the
+        batch dim of the ring over that mesh axis (dp x sp composed
+        meshes). No-op for memoryless policies."""
         if self.seq_policy:
             self.model = build_seq_model(
                 self.config.model, self.specs,
                 self.config.algo.init_log_std, mesh=mesh, sp_axis=sp_axis,
-                horizon=self.config.algo.horizon,
+                horizon=self.config.algo.horizon, batch_axis=batch_axis,
             )
 
     # -- sequence acting (model.encoder.kind='trajectory') -------------------
@@ -150,7 +152,8 @@ class SequenceActingMixin(PolicyHeadMixin):
 
 
 def build_seq_model(
-    model_config, specs, init_log_std, mesh=None, sp_axis="sp", horizon=None
+    model_config, specs, init_log_std, mesh=None, sp_axis="sp",
+    horizon=None, batch_axis=None,
 ):
     """Trajectory actor-critic from ``learner_config.model`` — shared by
     every learner that supports ``encoder.kind='trajectory'``. ``horizon``
@@ -190,11 +193,13 @@ def build_seq_model(
     if specs.discrete:
         return TrajectoryCategoricalPPOModel(
             encoder_cfg=enc_cfg, n_actions=specs.action.n,
-            mesh=mesh, sp_axis=sp_axis, cnn_cfg=cnn_cfg,
+            mesh=mesh, sp_axis=sp_axis, batch_axis=batch_axis,
+            cnn_cfg=cnn_cfg,
         )
     return TrajectoryPPOModel(
         encoder_cfg=enc_cfg,
         act_dim=int(specs.action.shape[0]),
         init_log_std=init_log_std,
-        mesh=mesh, sp_axis=sp_axis, cnn_cfg=cnn_cfg,
+        mesh=mesh, sp_axis=sp_axis, batch_axis=batch_axis,
+        cnn_cfg=cnn_cfg,
     )

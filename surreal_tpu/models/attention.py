@@ -39,6 +39,7 @@ class CausalSelfAttention(nn.Module):
     head_dim: int = 16
     mesh: Any = None          # jax.sharding.Mesh (hashable; static attr)
     sp_axis: str = "sp"
+    batch_axis: Any = None    # mesh axis for B (dp x sp composed meshes)
     compute_dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
 
@@ -88,8 +89,15 @@ class CausalSelfAttention(nn.Module):
                 )
             else:
                 q_, k_, v_ = q, k, v
+            # batch tiling only when B divides the dp axis (B is static):
+            # init's [1, 1, obs] dummy and the evaluator's B=1 video
+            # episode replicate their tiny batch instead
+            ba = self.batch_axis
+            if ba is not None and B % self.mesh.shape[ba] != 0:
+                ba = None
             out = ring_self_attention(
-                self.mesh, q_, k_, v_, causal=True, axis=self.sp_axis
+                self.mesh, q_, k_, v_, causal=True, axis=self.sp_axis,
+                batch_axis=ba,
             )[:, :T]
         else:
             out = full_attention(q, k, v, causal=True)
@@ -112,6 +120,7 @@ class TrajectoryEncoder(nn.Module):
     head_dim: int = 16
     mesh: Any = None
     sp_axis: str = "sp"
+    batch_axis: Any = None
     max_len: int = 4096
     cnn_cfg: Any = None  # model.cnn subtree as a plain dict, or None
     compute_dtype: jnp.dtype = jnp.bfloat16
@@ -162,6 +171,7 @@ class TrajectoryEncoder(nn.Module):
             attn = CausalSelfAttention(
                 num_heads=self.num_heads, head_dim=self.head_dim,
                 mesh=self.mesh, sp_axis=self.sp_axis,
+                batch_axis=self.batch_axis,
                 compute_dtype=self.compute_dtype,
                 param_dtype=self.param_dtype, name=f"attn{i}",
             )
@@ -211,6 +221,7 @@ class TrajectoryPPOModel(nn.Module):
     init_log_std: float = -0.5
     mesh: Any = None    # set via Learner.rebind_mesh for sp>1 topologies
     sp_axis: str = "sp"
+    batch_axis: Any = None
     cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
 
     @nn.compact
@@ -223,7 +234,8 @@ class TrajectoryPPOModel(nn.Module):
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
             max_len=int(cfg.get("max_len", 4096)),
             cnn_cfg=self.cnn_cfg,
-            mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
+            mesh=self.mesh, sp_axis=self.sp_axis,
+            batch_axis=self.batch_axis, name="trunk",
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
             h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)
@@ -256,6 +268,7 @@ class TrajectoryCategoricalPPOModel(nn.Module):
     n_actions: int
     mesh: Any = None
     sp_axis: str = "sp"
+    batch_axis: Any = None
     cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
 
     @nn.compact
@@ -268,7 +281,8 @@ class TrajectoryCategoricalPPOModel(nn.Module):
             num_heads=cfg["num_heads"], head_dim=cfg["head_dim"],
             max_len=int(cfg.get("max_len", 4096)),
             cnn_cfg=self.cnn_cfg,
-            mesh=self.mesh, sp_axis=self.sp_axis, name="trunk",
+            mesh=self.mesh, sp_axis=self.sp_axis,
+            batch_axis=self.batch_axis, name="trunk",
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
             h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)

@@ -172,21 +172,22 @@ def ring_attention(
 
 
 @functools.lru_cache(maxsize=32)
-def _ring_jit(mesh, axis: str, causal: bool, remat: bool):
-    """One compiled ring program per (mesh, axis, causal, remat) —
-    rebuilding the shard_map/jit per call would miss the jit cache and
-    recompile every eager invocation (Mesh is hashable, so it keys the
-    cache directly)."""
+def _ring_jit(mesh, axis: str, causal: bool, remat: bool, batch_axis):
+    """One compiled ring program per (mesh, axis, causal, remat,
+    batch_axis) — rebuilding the shard_map/jit per call would miss the
+    jit cache and recompile every eager invocation (Mesh is hashable, so
+    it keys the cache directly)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
 
+    spec = P(batch_axis, axis)
     attend = shard_map(
         functools.partial(
             ring_attention, axis_name=axis, causal=causal, remat=remat
         ),
         mesh=mesh,
-        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-        out_specs=P(None, axis),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
         check_vma=False,  # house style (parallel/dp.py): the loop carry
         # mixes axis-varying (q-derived) and freshly-created accumulators
     )
@@ -194,10 +195,15 @@ def _ring_jit(mesh, axis: str, causal: bool, remat: bool):
 
 
 def ring_self_attention(
-    mesh, q, k, v, causal: bool = False, axis: str = "sp", remat: bool = True
+    mesh, q, k, v, causal: bool = False, axis: str = "sp",
+    remat: bool = True, batch_axis: str | None = None,
 ):
     """Host-side convenience: run :func:`ring_attention` under
     ``shard_map`` with the time axis of [B, T, H, D] inputs sharded over
-    ``mesh[axis]`` (batch/heads replicated — shard those over dp/tp
+    ``mesh[axis]``. ``batch_axis`` additionally shards B over that mesh
+    axis (the dp x sp composed-mesh case) — attention rows are
+    independent in B, so the ring body is unchanged: collectives ride
+    only the sp axis, and each (dp, sp) tile works its local batch
+    block. With batch_axis=None batch/heads replicate (shard them
     outside if needed)."""
-    return _ring_jit(mesh, axis, causal, remat)(q, k, v)
+    return _ring_jit(mesh, axis, causal, remat, batch_axis)(q, k, v)

@@ -280,3 +280,42 @@ def test_trajectory_encoder_max_len_forwarded_and_validated():
 
     with pytest.raises(ValueError, match="max_len"):
         _traj_learner(horizon=64, max_len=32)
+
+
+def test_pixel_trajectory_remote_agent_acts():
+    """Remote acting composes with PIXEL trajectories: the client-side
+    K/V carry + uint8 frames through the per-frame CNN stem."""
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(16, 16, 2), dtype=np.dtype(np.uint8)),
+        action=ArraySpec(shape=(2,), dtype=np.dtype(np.float32)),
+    )
+    cfg = Config(
+        algo=Config(name="ppo", horizon=8),
+        model=Config(
+            cnn=Config(enabled=True, channels=(8, 16), kernels=(4, 3),
+                       strides=(2, 1), dense=32),
+            encoder=Config(kind="trajectory", features=32, num_layers=1,
+                           num_heads=2, head_dim=8),
+        ),
+    )
+    learner = build_learner(cfg, specs)
+    state = learner.init(jax.random.key(0))
+    pub = ParameterPublisher()
+    ps = ParameterServer(pub.address)
+    agent = None
+    try:
+        agent = PPOAgent(learner).connect(ps.address, state, fetch_every=5)
+        B = 2
+        obs = np.random.default_rng(0).integers(
+            0, 255, size=(B, 16, 16, 2), dtype=np.uint8
+        )
+        for t in range(3):
+            a, info = agent.remote_act(obs, jax.random.key(t))
+            assert np.isfinite(np.asarray(a)).all()
+            assert np.isfinite(np.asarray(info["logp"])).all()
+        assert int(agent._act_carry["pos"]) == 3
+    finally:
+        if agent is not None:
+            agent.close()
+        ps.close()
+        pub.close()
