@@ -604,6 +604,71 @@ def headline_scaling() -> list[dict]:
     return rows
 
 
+def ppo_trajectory_pendulum() -> dict:
+    """The long-context path's own cost (round-4/5 capability —
+    model.encoder.kind='trajectory'): fused rollout with KV-cached
+    incremental acting (O(T) attention per env step) + whole-segment
+    sequence learn, on the trajectory-tested pendulum workload. No
+    BASELINE class covers this (the reference has no attention policies);
+    the row documents what the capability costs next to the MLP headline."""
+    from surreal_tpu.launch.rollout import init_device_carry
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    num_envs, horizon = 1024, 128
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=horizon, epochs=2, num_minibatches=2),
+            model=Config(
+                encoder=Config(
+                    kind="trajectory", features=64, num_layers=2,
+                    num_heads=4, head_dim=16,
+                )
+            ),
+        ),
+        env_config=Config(name="jax:pendulum", num_envs=num_envs),
+        session_config=Config(
+            folder="/tmp/perf_traj",
+            metrics=Config(every_n_iters=10_000),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+        ),
+    ).extend(base_config())
+    trainer = Trainer(cfg)
+    key = jax.random.key(0)
+    key, init_key, env_key = jax.random.split(key, 3)
+    state = trainer.learner.init(init_key)
+    carry = init_device_carry(trainer.env, env_key, num_envs)
+    for _ in range(WARMUP):
+        key, it_key = jax.random.split(key)
+        state, carry, metrics = trainer._train_iter(state, carry, it_key)
+    jax.device_get(metrics)
+    flops = _iter_flops(trainer._train_iter, state, carry, key)
+
+    def fused_step(sc, k):
+        s, c = sc
+        s, c, m = trainer._train_iter(s, c, k)
+        return (s, c), m
+
+    _, sc_w = _timeit_chained(fused_step, (state, carry), key, iters=2)
+    dt, _ = _timeit_chained(fused_step, sc_w, key)
+    sps = ITERS * num_envs * horizon / dt
+    out = {
+        "workload": "PPO+trajectory-transformer jax:pendulum (long-context "
+                    "path; beyond-reference capability)",
+        "geometry": f"{num_envs} envs x {horizon} horizon, 2-layer causal "
+                    "attention, KV-cached acting",
+        "env_steps_per_s": sps,
+        "iter_ms": dt / ITERS * 1e3,
+    }
+    if flops is not None:
+        out["flops_per_iter"] = flops
+        out["model_flops_per_s"] = flops * ITERS / dt
+        out["mfu"] = out["model_flops_per_s"] / PEAK_FLOPS_BF16
+    return out
+
+
 def host_env_cheetah():
     """BASELINE config ② (PPO on dm_control cheetah-run, 32 actors) — the
     reference's ACTUAL operating shape: CPU MuJoCo envs feeding the chip
@@ -848,7 +913,8 @@ def main(argv=None) -> None:
     trace_fn = None
     for fn in (
         ppo_lift_headline, impala_pong, ddpg_prioritized_lift,
-        ddpg_prioritized_lift_1m, ppo_cnn_nut_pixels, host_env_cheetah,
+        ddpg_prioritized_lift_1m, ppo_cnn_nut_pixels,
+        ppo_trajectory_pendulum, host_env_cheetah,
     ):
         r = fn()
         if r is None:
