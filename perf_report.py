@@ -1232,9 +1232,13 @@ def newest_bench_artifact():
         try:
             with open(path) as f:
                 data = json.load(f)
-            # driver artifacts wrap the bench line under "parsed"
-            parsed = data.get("parsed", data)
-            if "value" in parsed:
+            # driver artifacts wrap the bench line under "parsed"; a
+            # FAILED round writes "parsed": null — `or data` (not a
+            # default) so null falls back too, and the isinstance guard
+            # lets any non-dict artifact fall through to the newest
+            # VALID bench file instead of raising TypeError (ADVICE r5)
+            parsed = (data.get("parsed") or data) if isinstance(data, dict) else None
+            if isinstance(parsed, dict) and "value" in parsed:
                 return os.path.basename(path), parsed
         except (OSError, json.JSONDecodeError):
             continue

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -50,9 +51,11 @@ def run_env_worker(
         obs = env.reset(seed=env_config.seed + worker_id)
         msg: dict = {"obs": obs}
         steps = 0
+        act_latency_ms = None  # EWMA of the server round trip (telemetry)
         while (max_steps is None or steps < max_steps) and not (
             stop_event is not None and stop_event.is_set()
         ):
+            t_send = time.monotonic()
             sock.send(pickle.dumps(msg, protocol=5))
             # poll in short slices so a stop request (set while we wait on
             # a server that already shut down) exits cleanly instead of
@@ -69,6 +72,11 @@ def run_env_worker(
                     f"worker {worker_id}: inference server silent for 120s"
                 )
             actions = pickle.loads(sock.recv())
+            rt_ms = (time.monotonic() - t_send) * 1e3
+            act_latency_ms = (
+                rt_ms if act_latency_ms is None
+                else 0.1 * rt_ms + 0.9 * act_latency_ms
+            )
             out = env.step(actions)
             steps += env.num_envs
             msg = {
@@ -79,6 +87,10 @@ def run_env_worker(
                     out.info.get("truncated", np.zeros_like(out.done))
                 ),
                 "terminal_obs": out.info.get("terminal_obs", out.obs),
+                # round-trip latency rides with the next request so the
+                # server can expose a fleet-wide act-latency gauge
+                # (inference_server.queue_stats 'server/act_latency_ms')
+                "act_latency_ms": act_latency_ms,
             }
             if "episode_returns" in out.info:
                 # completed-episode stats ride with the observations

@@ -74,6 +74,11 @@ class InferenceServer:
         # only by the server thread; GIL-atomic reads from the trainer.
         self.evicted_chunks = 0
         self.evicted_steps = 0
+        # serve latency + micro-batch width, EWMA over serves (telemetry
+        # spine: the queue-depth/latency side-band). Written only by the
+        # server thread; GIL-atomic float reads from the trainer.
+        self._serve_ms_ewma: float | None = None
+        self._serve_batch_ewma: float | None = None
 
         # rolling completed-episode stats shipped by workers (SURVEY.md
         # §5.5); read via episode_stats(). Window matches the host
@@ -81,6 +86,9 @@ class InferenceServer:
         # means the same thing on every trainer.
         self._ep_returns: "deque[float]" = deque(maxlen=20)
         self._ep_lengths: "deque[float]" = deque(maxlen=20)
+        # worker-reported act round-trip latency (ms), rolling window —
+        # the env_worker side of the latency story rides in with each msg
+        self._act_latencies: "deque[float]" = deque(maxlen=50)
         self._ep_lock = threading.Lock()
 
         self._ctx = zmq.Context.instance()
@@ -151,6 +159,7 @@ class InferenceServer:
         requests = [r for r in requests if not r[1].get("final")]
         if not requests:
             return
+        t0 = time.monotonic()
         obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
         with self._act_lock:
             actions, info = self._act_fn(obs)
@@ -164,6 +173,16 @@ class InferenceServer:
             offset += n
             self._record(ident, msg, actions[sl], {k: v[sl] for k, v in info.items()})
             self._sock.send_multipart([ident, pickle.dumps(actions[sl], protocol=5)])
+        ms = (time.monotonic() - t0) * 1e3
+        self._serve_ms_ewma = (
+            ms if self._serve_ms_ewma is None
+            else 0.1 * ms + 0.9 * self._serve_ms_ewma
+        )
+        b = float(len(obs))
+        self._serve_batch_ewma = (
+            b if self._serve_batch_ewma is None
+            else 0.1 * b + 0.9 * self._serve_batch_ewma
+        )
 
     def episode_stats(self) -> dict[str, float] | None:
         """Rolling mean return/length over the last completed episodes
@@ -182,6 +201,9 @@ class InferenceServer:
             with self._ep_lock:
                 self._ep_returns.extend(float(r) for r in msg["episode_returns"])
                 self._ep_lengths.extend(float(l) for l in msg["episode_lengths"])
+        if "act_latency_ms" in msg:
+            with self._ep_lock:
+                self._act_latencies.append(float(msg["act_latency_ms"]))
         track = self._tracks.setdefault(ident, _WorkerTrack())
         if "reward" not in msg and track.steps:
             # obs-only hello on an identity that already has partial steps:
@@ -232,6 +254,9 @@ class InferenceServer:
                 )
                 for k in track.steps[0]
             }
+            # birth stamp for the queue-latency gauge; consumers pop it
+            # (seed_trainer's _DataPlane.next_chunk) before training
+            chunk["_t_ready"] = time.monotonic()
             track.steps = []
             while True:
                 try:
@@ -252,13 +277,26 @@ class InferenceServer:
                         pass
 
     def queue_stats(self) -> dict[str, float]:
-        """Chunk-queue occupancy and eviction counts for the metrics
-        stream (the tensorplex fetch-queue-occupancy role)."""
-        return {
+        """Chunk-queue occupancy, eviction counts, and serve/act latency
+        for the metrics stream (the tensorplex fetch-queue-occupancy role,
+        plus the telemetry spine's latency side-band)."""
+        out = {
             "server/queue_depth": float(self.chunks.qsize()),
             "server/evicted_chunks": float(self.evicted_chunks),
             "server/evicted_steps": float(self.evicted_steps),
         }
+        # the two EWMAs are assigned non-atomically by the server thread;
+        # guard each on its own (a shared guard can race float(None))
+        if self._serve_ms_ewma is not None:
+            out["server/serve_ms"] = float(self._serve_ms_ewma)
+        if self._serve_batch_ewma is not None:
+            out["server/serve_batch"] = float(self._serve_batch_ewma)
+        with self._ep_lock:
+            if self._act_latencies:
+                out["server/act_latency_ms"] = sum(self._act_latencies) / len(
+                    self._act_latencies
+                )
+        return out
 
     def close(self) -> None:
         self._stop.set()

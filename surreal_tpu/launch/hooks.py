@@ -26,6 +26,7 @@ import jax
 from surreal_tpu.session.checkpoint import CheckpointManager, make_checkpoint_manager
 from surreal_tpu.session.config import Config
 from surreal_tpu.session.metrics import get_logger, make_metrics_writer
+from surreal_tpu.session.telemetry import Tracer
 from surreal_tpu.session.tracker import PeriodicTracker
 
 
@@ -58,6 +59,19 @@ class SessionHooks:
         os.makedirs(cfg.folder, exist_ok=True)
         self.log = get_logger(name, cfg.folder)
         self.writer = make_metrics_writer(cfg, name=name)
+        # telemetry spine: span tracing + JSONL event log under
+        # <folder>/telemetry/ (session/telemetry.py). Drivers record their
+        # phase spans through hooks.tracer so Trainer / OffPolicyTrainer /
+        # SEEDTrainer / the multi-host drivers cannot drift; hooks itself
+        # spans its own side-bands (metrics-sync, publish, eval,
+        # checkpoint) below. `.get` keeps configs saved before the knob
+        # existed loadable.
+        tel = cfg.get("telemetry", None)
+        self.tracer = Tracer(
+            cfg.folder,
+            enabled=bool(tel.enabled) if tel is not None else True,
+            name=name,
+        )
         self.ckpt: CheckpointManager | None = make_checkpoint_manager(cfg)
         self._ckpt_every = PeriodicTracker(max(1, cfg.checkpoint.every_n_iters))
         # optional step-aligned auxiliary state (the off-policy trainer
@@ -149,6 +163,7 @@ class SessionHooks:
         )
         self._last_train = m
         self.writer.write(env_steps, m)
+        self.tracer.log_metrics(env_steps, m)
 
     # -- restore -------------------------------------------------------------
     def restore(self, init_state):
@@ -241,35 +256,50 @@ class SessionHooks:
 
         m = None
         if self._metrics_every.track_increment():
-            raw = metrics() if callable(metrics) else (metrics or {})
-            m = {k: float(v) for k, v in raw.items()}
+            # the ONE device->host sync of the cadence window: float() on
+            # the device scalars blocks until the dispatched iterations
+            # land, so this span is the fenced wall-time of the window tail
+            with self.tracer.span("metrics-sync"):
+                raw = metrics() if callable(metrics) else (metrics or {})
+                m = {k: float(v) for k, v in raw.items()}
             m["time/env_steps"] = env_steps
             m["time/env_steps_per_s"] = (env_steps - self._steps0) / max(
                 time.time() - (self._t0 or time.time()), 1e-9
             )
             self._last_train = m
         if self._publisher is not None and self._pub_every.track_increment():
-            version = self._publisher.publish(
-                self._pub_agent.acting_view(resolve_state())
-            )
+            with self.tracer.span("param-publish", emit=True):
+                version = self._publisher.publish(
+                    self._pub_agent.acting_view(resolve_state())
+                )
             if m is not None:
                 m["publish/version"] = float(version)
                 self._last_train = m
         evaled: dict[str, float] = {}
         if self.evaluator is not None and self._eval_every.track_increment():
-            evaled = self.evaluator.evaluate(resolve_state(), key)
+            with self.tracer.span("eval", emit=True):
+                evaled = self.evaluator.evaluate(resolve_state(), key)
             self._last_eval = evaled
+        if m is not None:
+            # mirror the window's span accumulators as time/* scalars —
+            # AFTER the publish/eval blocks so this window's side-band
+            # spans land in this row, not the next (checkpoint fires after
+            # the write by design and stays in the next window)
+            m.update(self.tracer.flush_phases(env_steps))
+            self._last_train = m
         if m or evaled:
             self.writer.write(env_steps, {**(m or {}), **evaled})
+            self.tracer.log_metrics(env_steps, {**(m or {}), **evaled})
         if self.ckpt is not None and self._ckpt_every.track_increment():
-            self.ckpt.save(
-                iteration,
-                resolve_state(),
-                env_steps=env_steps,
-                metrics=self.last_metrics,
-            )
-            if self.extra_state_fn is not None:
-                self.ckpt.save_extra(iteration, self.extra_state_fn())
+            with self.tracer.span("checkpoint", emit=True):
+                self.ckpt.save(
+                    iteration,
+                    resolve_state(),
+                    env_steps=env_steps,
+                    metrics=self.last_metrics,
+                )
+                if self.extra_state_fn is not None:
+                    self.ckpt.save_extra(iteration, self.extra_state_fn())
         self._profiler_tick(iteration)
         stop = m is not None and on_metrics is not None and bool(
             on_metrics(iteration, m)
@@ -328,6 +358,7 @@ class SessionHooks:
         if self.ckpt is not None:
             self.ckpt.close()
         self.writer.close()
+        self.tracer.close()
 
 
 HOST_METRICS_WINDOW = 20  # rolling episode-return window; host loops size

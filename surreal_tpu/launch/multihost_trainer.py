@@ -58,6 +58,7 @@ from surreal_tpu.launch.trainer import Trainer
 from surreal_tpu.parallel.mesh import check_dp_divisible, replicate_state
 from surreal_tpu.parallel.multihost import local_batch_to_global
 from surreal_tpu.session.config import Config
+from surreal_tpu.session.telemetry import HeartbeatWriter, Tracer
 
 _COUNTER_SPLIT = 2**31  # int64 counters ride int32 collectives as (hi, lo)
 
@@ -154,6 +155,24 @@ class _MultiHostSession:
         if iteration % metrics_every != 0:
             return False
         return self._agree_stop(stop)
+
+    def _telemetry(self, hooks):
+        """Per-rank telemetry handles: rank 0 spans through hooks' tracer
+        (ranks > 0 get a disabled no-op tracer — same code path, zero
+        cost), and EVERY rank gets a HeartbeatWriter appending liveness
+        events to its own ``telemetry/heartbeat_rank<k>.jsonl``. Ranks
+        whose host cannot write the session folder disable themselves
+        silently (the folder need not be mounted off rank 0)."""
+        cfg = self.config.session_config
+        tel = cfg.get("telemetry", None)
+        tracer = hooks.tracer if hooks is not None else Tracer(None, enabled=False)
+        hb = HeartbeatWriter(
+            cfg.folder,
+            self.rank,
+            every_s=float(tel.heartbeat_every_s) if tel is not None else 10.0,
+            enabled=bool(tel.enabled) if tel is not None else True,
+        )
+        return tracer, hb
 
     def _begin_session(self, state):
         """Rank-0 session prologue shared by every multi-host run():
@@ -258,6 +277,7 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
         hooks = None
         try:
             hooks, state, iteration, env_steps = self._begin_session(state)
+            tracer, heartbeat = self._telemetry(hooks)
 
             def lazy_host_state():
                 return _to_host_local(state)
@@ -278,9 +298,14 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                 )(env_key)
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
-                    state, carry, metrics = self._train_iter(state, carry, it_key)
+                    # unfenced dispatch span (see launch/trainer.py's note)
+                    with tracer.span("train_iter"):
+                        state, carry, metrics = self._train_iter(
+                            state, carry, it_key
+                        )
                     iteration += 1
                     env_steps += steps_per_iter
+                    heartbeat.beat(iteration, env_steps)
                     stop = False
                     if hooks is not None:
                         _, stop = hooks.end_iteration(
@@ -310,14 +335,17 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                     # straight into the per-step jitted act would re-pay
                     # it every env step of the rollout
                     act_base = _acting_refresh(act_base, state)
-                    obs, batch, ep_stats = host_rollout(
-                        self.env, self._act, act_base, obs,
-                        jax.random.fold_in(r_key, self.rank), self.horizon,
-                    )
+                    with tracer.span("rollout"):
+                        obs, batch, ep_stats = host_rollout(
+                            self.env, self._act, act_base, obs,
+                            jax.random.fold_in(r_key, self.rank), self.horizon,
+                        )
                     gbatch = local_batch_to_global(self.mesh, batch, batch_dim=1)
-                    state, metrics = self._learn(state, gbatch, l_key)
+                    with tracer.span("learn"):
+                        state, metrics = self._learn(state, gbatch, l_key)
                     iteration += 1
                     env_steps += steps_per_iter
+                    heartbeat.beat(iteration, env_steps)
                     recent_returns.extend(ep_stats["returns"])
                     stop = False
                     if hooks is not None:
@@ -400,6 +428,7 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
         hooks = None
         try:
             hooks, state, iteration, env_steps = self._begin_session(state)
+            tracer, heartbeat = self._telemetry(hooks)
 
             def lazy_host_state():
                 return _to_host_local(state)
@@ -429,13 +458,16 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
                 warmup = jnp.asarray(
                     env_steps < self.algo.exploration.warmup_steps
                 )
-                state, replay_state, carry, metrics = self._train_iter(
-                    state, replay_state, carry, it_key, beta, warmup,
-                    jnp.asarray(first_call),
-                )
+                # unfenced dispatch span (see launch/trainer.py's note)
+                with tracer.span("train_iter"):
+                    state, replay_state, carry, metrics = self._train_iter(
+                        state, replay_state, carry, it_key, beta, warmup,
+                        jnp.asarray(first_call),
+                    )
                 first_call = False
                 iteration += 1
                 env_steps += steps_per_iter
+                heartbeat.beat(iteration, env_steps)
                 stop = False
                 if hooks is not None:
                     _, stop = hooks.end_iteration(
@@ -543,6 +575,7 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
         stop = threading.Event()
         try:
             hooks, state, iteration, env_steps = self._begin_session(state)
+            tracer, heartbeat = self._telemetry(hooks)
 
             def lazy_host_state():
                 return _to_host_local(state)
@@ -564,17 +597,23 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
             self._workers = plane.workers  # exposed for tests/fault injection
 
             while env_steps < total:
-                chunk = plane.next_chunk()
+                with tracer.span("chunk-wait"):
+                    chunk = plane.next_chunk()
                 versions = chunk.pop("param_version")
                 staleness = server.version - int(versions.min())
                 gbatch = local_batch_to_global(self.mesh, chunk, batch_dim=1)
                 key, lkey, hk_key = jax.random.split(key, 3)
-                state, metrics = self._learn(state, gbatch, lkey)
-                server.set_act_fn(
-                    self._make_act_fn(self._refresh_act_state(state), key_holder)
-                )
+                with tracer.span("learn"):
+                    state, metrics = self._learn(state, gbatch, lkey)
+                with tracer.span("param-publish"):
+                    server.set_act_fn(
+                        self._make_act_fn(
+                            self._refresh_act_state(state), key_holder
+                        )
+                    )
                 iteration += 1
                 env_steps += steps_per_iter
+                heartbeat.beat(iteration, env_steps)
                 plane.supervise()
                 stop_flag = False
                 if hooks is not None:
@@ -585,6 +624,7 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                         **{
                             "staleness/updates_behind": float(staleness),
                             "workers/respawns": float(plane.respawns),
+                            "server/chunk_age_s": float(plane.last_chunk_age_s),
                         },
                         **server.queue_stats(),
                         **(server.episode_stats() or {}),

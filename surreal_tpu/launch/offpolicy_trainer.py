@@ -253,6 +253,14 @@ class OffPolicyTrainer:
                 state, metrics = self.learner.learn(
                     state, batch, update_key, axis_name
                 )
+                # sample-staleness gauge (device scalar, telemetry spine):
+                # how old the drawn transitions are relative to the fill.
+                # Each dp shard draws its own indices, so pmean keeps the
+                # scalar genuinely replicated for the shard_map out spec
+                age = self.replay.age_frac(replay_state, info["idx"])
+                if axis_name is not None:
+                    age = jax.lax.pmean(age, axis_name)
+                metrics["replay/sample_age_frac"] = age
                 td_abs = metrics.pop("priority/td_abs")
                 if self.prioritized:
                     replay_state = self.replay.update_priorities(
@@ -269,12 +277,14 @@ class OffPolicyTrainer:
 
         def skip_updates(operand):
             state, replay_state = operand
-            zero_metrics = {
-                "loss/critic": jnp.zeros(()),
-                "loss/actor": jnp.zeros(()),
-                "q/mean_target": jnp.zeros(()),
-                "q/mean_abs_td": jnp.zeros(()),
-            }
+            # lax.cond branches must return one pytree structure: derive
+            # the zero metrics tree from run_updates' OWN output shape
+            # (abstract trace only — nothing executes), so new learner /
+            # health / gauge keys can never desync the two branches
+            metrics_shape = jax.eval_shape(lambda: run_updates(operand)[2])
+            zero_metrics = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+            )
             return state, replay_state, zero_metrics
 
         state, replay_state, metrics = jax.lax.cond(
@@ -290,6 +300,10 @@ class OffPolicyTrainer:
             replay_state = replay_state._replace(
                 max_priority=jax.lax.pmax(replay_state.max_priority, axis_name)
             )
+        # replay occupancy gauges, after the pmax so prioritized
+        # max_priority is the globally-synced value (fills/sizes are
+        # lockstep-identical across shards by construction)
+        metrics.update(self.replay.gauges(replay_state))
         n_done = traj["ep_done"].sum()
         ep_return_sum = traj["ep_return"].sum()
         if axis_name is not None:
@@ -356,10 +370,12 @@ class OffPolicyTrainer:
                 warmup = jnp.asarray(
                     env_steps < self.algo.exploration.warmup_steps
                 )
-                state, replay_state, carry, metrics = self._train_iter(
-                    state, replay_state, carry, it_key, beta, warmup,
-                    jnp.asarray(first_call),
-                )
+                # unfenced dispatch span (see launch/trainer.py's note)
+                with hooks.tracer.span("train_iter"):
+                    state, replay_state, carry, metrics = self._train_iter(
+                        state, replay_state, carry, it_key, beta, warmup,
+                        jnp.asarray(first_call),
+                    )
                 first_call = False
                 iteration += 1
                 env_steps += steps_per_iter
@@ -425,40 +441,41 @@ class OffPolicyTrainer:
         while env_steps < total:
             steps = []
             warmup = env_steps < explo.warmup_steps
-            for _ in range(self.horizon):
-                key, akey, nkey = jax.random.split(key, 3)
-                if warmup:
-                    action = np.random.default_rng(
-                        int(jax.random.randint(akey, (), 0, 2**31 - 1))
-                    ).uniform(-1.0, 1.0, (self.num_envs, act_dim)).astype(np.float32)
-                elif explo.noise == "ou":
-                    a_det, _ = self._act(state, jnp.asarray(obs), akey, mode="eval_deterministic")
-                    noise = np.asarray(
-                        ou_noise_step(jnp.asarray(noise), nkey, explo.ou_theta, explo.sigma, explo.ou_dt)
+            with hooks.tracer.span("rollout"):
+                for _ in range(self.horizon):
+                    key, akey, nkey = jax.random.split(key, 3)
+                    if warmup:
+                        action = np.random.default_rng(
+                            int(jax.random.randint(akey, (), 0, 2**31 - 1))
+                        ).uniform(-1.0, 1.0, (self.num_envs, act_dim)).astype(np.float32)
+                    elif explo.noise == "ou":
+                        a_det, _ = self._act(state, jnp.asarray(obs), akey, mode="eval_deterministic")
+                        noise = np.asarray(
+                            ou_noise_step(jnp.asarray(noise), nkey, explo.ou_theta, explo.sigma, explo.ou_dt)
+                        )
+                        action = np.clip(np.asarray(a_det) + noise, -1.0, 1.0)
+                    else:
+                        a, _ = self._act(state, jnp.asarray(obs), akey, mode="training")
+                        action = np.asarray(a)
+                    out = self.env.step(action)
+                    term_obs = out.info.get("terminal_obs", out.obs)
+                    done_b = out.done.reshape(out.done.shape + (1,) * (out.obs.ndim - 1))
+                    truncated = np.asarray(out.info.get("truncated", np.zeros(len(out.done), bool)))
+                    steps.append(
+                        {
+                            "obs": obs,
+                            "next_obs": np.where(done_b, term_obs, out.obs),
+                            "action": action,
+                            "reward": out.reward,
+                            "done": out.done,
+                            "terminated": out.done & ~truncated,
+                        }
                     )
-                    action = np.clip(np.asarray(a_det) + noise, -1.0, 1.0)
-                else:
-                    a, _ = self._act(state, jnp.asarray(obs), akey, mode="training")
-                    action = np.asarray(a)
-                out = self.env.step(action)
-                term_obs = out.info.get("terminal_obs", out.obs)
-                done_b = out.done.reshape(out.done.shape + (1,) * (out.obs.ndim - 1))
-                truncated = np.asarray(out.info.get("truncated", np.zeros(len(out.done), bool)))
-                steps.append(
-                    {
-                        "obs": obs,
-                        "next_obs": np.where(done_b, term_obs, out.obs),
-                        "action": action,
-                        "reward": out.reward,
-                        "done": out.done,
-                        "terminated": out.done & ~truncated,
-                    }
-                )
-                if out.done.any():
-                    noise[out.done] = 0.0
-                if "episode_returns" in out.info:
-                    recent_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
-                obs = out.obs
+                    if out.done.any():
+                        noise[out.done] = 0.0
+                    if "episode_returns" in out.info:
+                        recent_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
+                    obs = out.obs
             traj = {k: jnp.asarray(np.stack([s[k] for s in steps])) for k in steps[0]}
             if host_tail is not None:
                 full = jax.tree.map(
@@ -477,23 +494,30 @@ class OffPolicyTrainer:
                     trans, self.algo.n_step, self.num_envs
                 )
             first_chunk = False
-            replay_state = self._insert(replay_state, trans)
+            with hooks.tracer.span("replay-insert"):
+                replay_state = self._insert(replay_state, trans)
             state = self.learner.update_obs_stats(state, traj["obs"])
             if bool(self.replay.can_sample(replay_state)):
                 beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
                 for _ in range(self.algo.updates_per_iter):
                     key, skey = jax.random.split(key)
-                    if self.prioritized:
-                        replay_state, batch, info = self._sample(replay_state, skey, beta=beta)
-                        batch = dict(batch, is_weights=info["is_weights"])
-                    else:
-                        replay_state, batch, info = self._sample(replay_state, skey)
-                    state, metrics = self._learn(state, batch, skey)
+                    with hooks.tracer.span("replay-sample"):
+                        if self.prioritized:
+                            replay_state, batch, info = self._sample(replay_state, skey, beta=beta)
+                            batch = dict(batch, is_weights=info["is_weights"])
+                        else:
+                            replay_state, batch, info = self._sample(replay_state, skey)
+                    with hooks.tracer.span("learn"):
+                        state, metrics = self._learn(state, batch, skey)
                     td_abs = metrics.pop("priority/td_abs")
                     if self.prioritized:
                         replay_state = self._update_prio(replay_state, info["idx"], td_abs)
+                metrics["replay/sample_age_frac"] = self.replay.age_frac(
+                    replay_state, info["idx"]
+                )
             else:
                 metrics = {}
+            metrics = dict(metrics, **self.replay.gauges(replay_state))
             iteration += 1
             env_steps += steps_per_iter
             key, hk_key = jax.random.split(key)

@@ -59,6 +59,7 @@ class _DataPlane:
         self.respawns = 0
         self._timeout = first_timeout
         self.steady_timeout = 30.0
+        self.last_chunk_age_s = 0.0  # queue dwell of the last chunk served
 
     def supervise(self) -> None:
         self.respawns += self.trainer._respawn_dead_workers(
@@ -70,7 +71,13 @@ class _DataPlane:
         self._timeout = self.steady_timeout
         while True:
             try:
-                return self.server.chunks.get(timeout=2.0)
+                chunk = self.server.chunks.get(timeout=2.0)
+                # queue-latency gauge: how long the chunk waited for the
+                # learner (the server stamps _t_ready at assembly)
+                self.last_chunk_age_s = time.monotonic() - chunk.pop(
+                    "_t_ready", time.monotonic()
+                )
+                return chunk
             except queue.Empty:
                 self.supervise()
                 if time.monotonic() >= deadline:
@@ -325,12 +332,14 @@ class SEEDTrainer:
                     "staleness/dropped_chunks": float(dropped_stale),
                     "staleness/steps_discarded": float(discarded_steps),
                     "workers/respawns": float(plane.respawns),
+                    "server/chunk_age_s": float(plane.last_chunk_age_s),
                     **server.queue_stats(),
                     **(server.episode_stats() or {}),
                 }
 
             while env_steps < total:
-                chunk = plane.next_chunk()
+                with hooks.tracer.span("chunk-wait"):
+                    chunk = plane.next_chunk()
                 versions = chunk.pop("param_version")
                 staleness = server.version - int(versions.min())
                 # Accounting contract: trainer-side stale DROPS count into
@@ -352,20 +361,23 @@ class SEEDTrainer:
                     discarded_steps += n_dropped
                     plane.supervise()
                     continue
-                if self.mesh is not None:
-                    # split host->devices directly along the dp-sharded
-                    # batch dim; a plain device_put would commit the whole
-                    # chunk to device 0 and reshard inside the jit
-                    from surreal_tpu.parallel.mesh import batch_sharded
+                with hooks.tracer.span("h2d-transfer"):
+                    if self.mesh is not None:
+                        # split host->devices directly along the dp-sharded
+                        # batch dim; a plain device_put would commit the
+                        # whole chunk to device 0 and reshard inside the jit
+                        from surreal_tpu.parallel.mesh import batch_sharded
 
-                    batch = jax.device_put(
-                        chunk, batch_sharded(self.mesh, batch_dim=1)
-                    )
-                else:
-                    batch = jax.device_put(chunk)
+                        batch = jax.device_put(
+                            chunk, batch_sharded(self.mesh, batch_dim=1)
+                        )
+                    else:
+                        batch = jax.device_put(chunk)
                 key, lkey, hk_key = jax.random.split(key, 3)
-                state, metrics = self._learn(state, batch, lkey)
-                server.set_act_fn(self._make_act_fn(state, key_holder))
+                with hooks.tracer.span("learn"):
+                    state, metrics = self._learn(state, batch, lkey)
+                with hooks.tracer.span("param-publish"):
+                    server.set_act_fn(self._make_act_fn(state, key_holder))
                 iteration += 1
                 env_steps += chunk["reward"].shape[0] * chunk["reward"].shape[1]
                 plane.supervise()

@@ -97,6 +97,11 @@ class Trainer:
                     # batch per update (no num_minibatches key) — the
                     # full-batch check above is the binding one there.
                     mb = self.learner.config.algo.get("num_minibatches", 1)
+                    # models/attention.py re-asserts this same invariant at
+                    # the learn-pass shape (B>1, T>1) inside the ring's
+                    # batch-tiling fallback — the two sites must not drift
+                    # (ADVICE r5 low: a mis-sized learn batch used to fall
+                    # back to silent full replication)
                     check_dp_divisible(
                         self.num_envs // mb, dp,
                         what="num_envs/num_minibatches (the ring's "
@@ -201,7 +206,14 @@ class Trainer:
                     carry = jax.device_put(carry, self._sp_carry_sharding)
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
-                    state, carry, metrics = self._train_iter(state, carry, it_key)
+                    # span is UNFENCED (dispatch time): fencing here would
+                    # serialize the async pipeline; window totals are
+                    # honest under backpressure and the cadence sync in
+                    # end_iteration is the real fence (session/telemetry.py)
+                    with hooks.tracer.span("train_iter"):
+                        state, carry, metrics = self._train_iter(
+                            state, carry, it_key
+                        )
                     iteration += 1
                     env_steps += steps_per_iter
                     _, stop = hooks.end_iteration(
@@ -240,10 +252,12 @@ class Trainer:
         recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
         while env_steps < total:
             key, r_key, l_key, hk_key = jax.random.split(key, 4)
-            obs, batch, ep_stats = host_rollout(
-                self.env, self._act, state, obs, r_key, self.horizon
-            )
-            state, metrics = self._learn(state, batch, l_key)
+            with hooks.tracer.span("rollout"):
+                obs, batch, ep_stats = host_rollout(
+                    self.env, self._act, state, obs, r_key, self.horizon
+                )
+            with hooks.tracer.span("learn"):
+                state, metrics = self._learn(state, batch, l_key)
             iteration += 1
             env_steps += steps_per_iter
             recent_returns.extend(ep_stats["returns"])
@@ -280,16 +294,19 @@ class Trainer:
         out: queue_mod.Queue = queue_mod.Queue(maxsize=1)
         stop_evt = threading.Event()
 
+        tracer = hooks.tracer  # thread-safe; the collector spans "rollout"
+
         def collect():
             obs = self.env.reset(seed=self.config.env_config.seed)
             k = roll_key
             try:
                 while not stop_evt.is_set():
                     k, r_key = jax.random.split(k)
-                    obs, batch, ep_stats = host_rollout(
-                        self.env, self._act, act_state[0], obs, r_key,
-                        self.horizon,
-                    )
+                    with tracer.span("rollout"):
+                        obs, batch, ep_stats = host_rollout(
+                            self.env, self._act, act_state[0], obs, r_key,
+                            self.horizon,
+                        )
                     item = (batch, ep_stats)
                     while not stop_evt.is_set():
                         try:
@@ -305,12 +322,14 @@ class Trainer:
         recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
         try:
             while env_steps < total:
-                got = out.get()
+                with tracer.span("chunk-wait"):
+                    got = out.get()
                 if isinstance(got, BaseException):
                     raise got
                 batch, ep_stats = got
                 key, l_key, hk_key = jax.random.split(key, 3)
-                state, metrics = self._learn(state, batch, l_key)
+                with tracer.span("learn"):
+                    state, metrics = self._learn(state, batch, l_key)
                 act_state[0] = state  # device-resident; no host copy
                 iteration += 1
                 env_steps += steps_per_iter

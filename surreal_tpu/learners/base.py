@@ -23,6 +23,8 @@ import abc
 from typing import Any, Mapping
 
 import jax
+import jax.numpy as jnp
+import optax
 
 from surreal_tpu.envs.base import EnvSpecs
 
@@ -30,6 +32,38 @@ from surreal_tpu.envs.base import EnvSpecs
 TRAINING = "training"
 EVAL_DETERMINISTIC = "eval_deterministic"
 EVAL_STOCHASTIC = "eval_stochastic"
+
+
+def training_health(old_params, new_params, grad_norm: jax.Array) -> dict:
+    """In-graph training-health diagnostics, shared by every learner's
+    ``learn`` (the telemetry spine's health signals): grad norm, param
+    norm, update ratio, and a NaN/inf guard.
+
+    Every output is a DEVICE scalar computed inside the jitted step: it
+    rides the metrics dict and reaches the host only when the existing
+    ``metrics.every_n_iters`` cadence syncs, so the hot loop gains ZERO
+    additional device->host syncs (tests/test_telemetry.py proves this
+    with a transfer-guard test).
+
+    ``grad_norm`` is supplied by the caller because the gradients live at
+    different places per algorithm (PPO's sit inside its minibatch scan;
+    DDPG has two trees). The nonfinite guard keys off the norms:
+    ``optax.global_norm`` is nonfinite iff any element is (inf/nan
+    propagate through the sum of squares), so one isfinite check covers
+    the whole tree without a second reduction.
+    """
+    old_norm = optax.global_norm(old_params)
+    new_norm = optax.global_norm(new_params)
+    update_norm = optax.global_norm(
+        jax.tree.map(lambda a, b: a - b, new_params, old_params)
+    )
+    finite = jnp.isfinite(grad_norm) & jnp.isfinite(new_norm)
+    return {
+        "health/grad_norm": grad_norm,
+        "health/param_norm": new_norm,
+        "health/update_ratio": update_norm / (old_norm + 1e-12),
+        "health/nonfinite": 1.0 - finite.astype(jnp.float32),
+    }
 
 
 class Learner(abc.ABC):

@@ -22,7 +22,7 @@ import numpy as np
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
-from surreal_tpu.learners.base import TRAINING, Learner
+from surreal_tpu.learners.base import TRAINING, Learner, training_health
 from surreal_tpu.models.ddpg_net import DDPGActor, DDPGCritic
 from surreal_tpu.ops.running_stats import (
     RunningStats,
@@ -241,6 +241,12 @@ class DDPGLearner(Learner):
             "loss/actor": a_loss,
             "q/mean_target": target.mean(),
             "q/mean_abs_td": jnp.abs(td).mean(),
+            # one health set over BOTH trees (grads already pmean'd above)
+            **training_health(
+                {"actor": state.actor_params, "critic": state.critic_params},
+                {"actor": actor_params, "critic": critic_params},
+                optax.global_norm({"actor": a_grads, "critic": c_grads}),
+            ),
         }
         if axis_name is not None:
             metrics = jax.lax.pmean(metrics, axis_name)

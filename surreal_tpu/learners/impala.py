@@ -21,7 +21,7 @@ import numpy as np
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
-from surreal_tpu.learners.base import TRAINING, Learner
+from surreal_tpu.learners.base import TRAINING, Learner, training_health
 from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
@@ -216,6 +216,8 @@ class IMPALALearner(SequenceActingMixin, Learner):
             "loss/value": aux["v_loss"],
             "policy/entropy": aux["entropy"],
             "policy/rho_mean": aux["rho_mean"],
+            # grads are already pmean'd, so the health scalars replicate
+            **training_health(state.params, params, optax.global_norm(grads)),
         }
         return new_state, metrics
 

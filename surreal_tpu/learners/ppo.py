@@ -31,7 +31,12 @@ import jax.numpy as jnp
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
-from surreal_tpu.learners.base import EVAL_DETERMINISTIC, TRAINING, Learner
+from surreal_tpu.learners.base import (
+    EVAL_DETERMINISTIC,
+    TRAINING,
+    Learner,
+    training_health,
+)
 from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
@@ -423,6 +428,9 @@ class PPOLearner(SequenceActingMixin, Learner):
             if axis_name is not None:
                 grads = jax.lax.pmean(grads, axis_name)
                 aux = jax.lax.pmean(aux, axis_name)
+            # after the pmean so every replica reports the merged norm;
+            # feeds the health/* diagnostics in _finalize
+            aux["grad_norm"] = optax.global_norm(grads)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             stopped = jnp.logical_or(
@@ -488,6 +496,9 @@ class PPOLearner(SequenceActingMixin, Learner):
             - jnp.var(value_targets - values) / ev_denom,
             "adv/mean_abs": jnp.abs(advantages).mean(),
         }
+        metrics.update(
+            training_health(state.params, params, auxs["grad_norm"].mean())
+        )
         if axis_name is not None:
             # per-shard metrics (explained variance etc.) -> global mean so
             # the replicated out-spec is truthful

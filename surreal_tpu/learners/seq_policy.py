@@ -141,9 +141,14 @@ class SequenceActingMixin(PolicyHeadMixin):
             buf, obs.astype(buf.dtype)[:, None], pos, axis=1
         )
         # causal attention: position `pos` sees only the 0..pos prefix —
-        # the zero padding at future positions is unread by construction
+        # the zero padding at future positions is unread by construction.
+        # replicate_ok: this is an ACTING batch (eval episodes / video) of
+        # arbitrary width — on a dp x sp mesh an indivisible width falls
+        # back to replication here, while the learn pass keeps the
+        # divisibility assert (models/attention.py)
         out = self.model.apply(
-            state.params, self._norm_obs(state.obs_stats, buf)
+            state.params, self._norm_obs(state.obs_stats, buf),
+            replicate_ok=True,
         )
         at = lambda x: jax.lax.dynamic_index_in_dim(x, pos, axis=1, keepdims=False)
         out_t = jax.tree.map(at, out)

@@ -176,13 +176,16 @@ def _run_local_group(args) -> int:
     group would deadlock the survivors' next collective)."""
     # Picking the coordinator port by bind-then-close is a TOCTOU race:
     # another process can grab it before rank 0 binds. One retry with a
-    # fresh port (when the group dies inside the startup window) makes
-    # the race a non-event instead of a failed launch.
+    # fresh port (when the group dies inside the startup window AND the
+    # failure looks like the coordinator, not a deterministic startup
+    # error) makes the race a non-event instead of a failed launch.
     code = _spawn_local_group_once(args, retry_early_failure=True)
     if code == _EARLY_GROUP_FAILURE:
         print(
-            "local group failed during startup (coordinator port race?); "
-            "retrying once with a fresh port",
+            "local group failed during startup and the failed rank's log "
+            "matches a JAX coordinator bind/connect failure (or the log is "
+            "not inspectable); retrying once with a fresh port. The retry "
+            "is SPECULATIVE — a deterministic failure will simply repeat.",
             file=sys.stderr,
         )
         code = _spawn_local_group_once(args, retry_early_failure=False)
@@ -190,6 +193,42 @@ def _run_local_group(args) -> int:
 
 
 _EARLY_GROUP_FAILURE = -255  # sentinel: group died inside the startup window
+
+# error signatures of the jax.distributed coordinator losing its port race
+# (rank 0's bind, other ranks' connect/handshake against a dead address) —
+# deterministic startup failures (bad flag, import error, config typo) match
+# none of these and must NOT respawn the group (ADVICE r5 low)
+_COORDINATOR_FAILURE_RE = None  # compiled lazily (keeps module import light)
+
+
+def _log_suggests_coordinator_race(folder: str, rank: int) -> bool:
+    """Inspect the failed rank's log tail for the coordinator bind/connect
+    signature. Rank 0 owns the terminal (no log file) — and rank 0 is
+    exactly where the bind race fires — so an uninspectable log keeps the
+    retry allowed rather than suppressing it."""
+    global _COORDINATOR_FAILURE_RE
+    if rank == 0:
+        return True
+    if _COORDINATOR_FAILURE_RE is None:
+        import re
+
+        _COORDINATOR_FAILURE_RE = re.compile(
+            r"coordination service|coordinator|jax\.distributed|"
+            r"Failed to bind|Address already in use|errno 98|"
+            r"UNAVAILABLE|DEADLINE_EXCEEDED|failed to connect|"
+            r"Connection refused|barrier timed out",
+            re.IGNORECASE,
+        )
+    path = os.path.join(folder, f"rank{rank}.log")
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 8192))
+            tail = f.read().decode("utf-8", "replace")
+    except OSError:
+        return True  # can't inspect -> keep the (speculative) retry
+    return bool(_COORDINATOR_FAILURE_RE.search(tail))
 
 
 def _spawn_local_group_once(args, retry_early_failure: bool) -> int:
@@ -231,8 +270,12 @@ def _spawn_local_group_once(args, retry_early_failure: bool) -> int:
         try:
             while True:
                 codes = [p.poll() for p in procs]
-                bad = next((c for c in codes if c not in (None, 0)), None)
-                if bad is not None:
+                bad_rank = next(
+                    (i for i, c in enumerate(codes) if c not in (None, 0)),
+                    None,
+                )
+                if bad_rank is not None:
+                    bad = codes[bad_rank]
                     for p in procs:
                         if p.poll() is None:
                             p.terminate()
@@ -244,11 +287,17 @@ def _spawn_local_group_once(args, retry_early_failure: bool) -> int:
                             p.kill()
                     # retry only plausible port races: a child that died
                     # from a signal (bad < 0, e.g. the user's Ctrl+C
-                    # forwarded to the group) must not respawn the group
+                    # forwarded to the group) must not respawn the group,
+                    # and neither must a deterministic startup failure —
+                    # the failed rank's log tail must match the jax
+                    # coordinator bind/connect signature
                     if (
                         retry_early_failure
                         and bad > 0
                         and time.monotonic() - start < 15
+                        and _log_suggests_coordinator_race(
+                            args.folder, bad_rank
+                        )
                     ):
                         return _EARLY_GROUP_FAILURE
                     return int(bad)
@@ -622,6 +671,32 @@ def run_eval(args) -> int:
     return 0
 
 
+def run_diag(args) -> int:
+    """Offline session diagnosis from the telemetry spine's JSONL logs
+    (session/telemetry.py): phase-time breakdown, training-health
+    summary, last-heartbeat table. Pure file reading — no jax backend is
+    touched, so it runs off-chip and against LIVE sessions."""
+    from surreal_tpu.session.telemetry import diag_report, diag_summary
+
+    if args.json:
+        summary = diag_summary(args.folder)
+        if summary is None:
+            print(f"no telemetry under {args.folder!r} "
+                  "(session_config.telemetry.enabled=false, or not a "
+                  "session folder?)", file=sys.stderr)
+            return 2
+        print(json.dumps(summary, default=float))
+        return 0
+    report = diag_report(args.folder)
+    if report is None:
+        print(f"no telemetry under {args.folder!r} "
+              "(session_config.telemetry.enabled=false, or not a "
+              "session folder?)", file=sys.stderr)
+        return 2
+    print(report)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="surreal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -702,6 +777,15 @@ def main(argv=None) -> int:
                         "server/first publish")
     a.add_argument("--seed", type=int, default=0)
     a.set_defaults(fn=run_actor)
+
+    d = sub.add_parser("diag", help="offline session diagnosis from the "
+                       "telemetry JSONL log: phase times, health summary, "
+                       "heartbeats (works off-chip and on live sessions)")
+    d.add_argument("folder", help="session folder (holds telemetry/)")
+    d.add_argument("--json", action="store_true",
+                   help="print the aggregated summary as one JSON object "
+                        "instead of the human-readable report")
+    d.set_defaults(fn=run_diag)
 
     args = parser.parse_args(argv)
     # the --local-procs supervisor re-issues this exact command per rank

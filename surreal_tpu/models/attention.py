@@ -44,12 +44,18 @@ class CausalSelfAttention(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, cache=None, pos=None):
+    def __call__(self, x: jax.Array, *, cache=None, pos=None,
+                 replicate_ok: bool = False):
         """Full path: x [B, T, E] -> [B, T, E]. Decode path (``cache`` a
         {'k','v'} dict of [B, T, H, D], ``pos`` the write index): x is
         ONE position [B, E]; returns ([B, E], new_cache) — O(T) per step
         instead of re-attending the whole padded segment. Param tree is
-        identical in both modes (same named submodules)."""
+        identical in both modes (same named submodules).
+
+        ``replicate_ok``: acting-path callers (padded act over an
+        arbitrary-width eval batch) opt INTO the silent batch-replication
+        fallback on an indivisible ``batch_axis``; learn-pass callers
+        keep the default and hit the divisibility assert below."""
         H, D = self.num_heads, self.head_dim
         proj = lambda name: nn.DenseGeneral(
             (H, D), axis=-1, name=name,
@@ -90,10 +96,25 @@ class CausalSelfAttention(nn.Module):
             else:
                 q_, k_, v_ = q, k, v
             # batch tiling only when B divides the dp axis (B is static):
-            # init's [1, 1, obs] dummy and the evaluator's B=1 video
-            # episode replicate their tiny batch instead
+            # init's [1, 1, obs] dummy, the evaluator's B=1 video episode,
+            # and replicate_ok acting callers (padded act over an eval
+            # batch of any width) replicate their batch instead. A
+            # NON-trivial batch on a learn-pass shape (B>1 AND T>1) must
+            # NOT silently replicate — that quiet perf cliff is exactly
+            # what the Trainer-side check_dp_divisible (launch/trainer.py,
+            # sp>1 branch) rejects; this assert is its model-side twin so
+            # the two sites cannot drift (ADVICE r5 low).
             ba = self.batch_axis
             if ba is not None and B % self.mesh.shape[ba] != 0:
+                if B > 1 and T > 1 and not replicate_ok:
+                    raise ValueError(
+                        f"ring-attention batch B={B} is not divisible by "
+                        f"mesh axis {ba!r}={self.mesh.shape[ba]} on a "
+                        f"learn-pass shape (T={T}): refusing to silently "
+                        "replicate the batch. Fix num_envs/num_minibatches "
+                        "vs mesh dp (see check_dp_divisible in "
+                        "launch/trainer.py)."
+                    )
                 ba = None
             out = ring_self_attention(
                 self.mesh, q_, k_, v_, causal=True, axis=self.sp_axis,
@@ -127,10 +148,13 @@ class TrajectoryEncoder(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, obs: jax.Array, *, cache=None, pos=None):
+    def __call__(self, obs: jax.Array, *, cache=None, pos=None,
+                 replicate_ok: bool = False):
         """Full path: [B, T, obs] -> [B, T, features]. Decode path
         (``cache`` a per-layer list of K/V dicts, ``pos`` the position):
-        obs is [B, obs]; returns ([B, features], new_cache)."""
+        obs is [B, obs]; returns ([B, features], new_cache).
+        ``replicate_ok`` forwards to the attention layers (see
+        :class:`CausalSelfAttention`)."""
         decode = cache is not None
         embed = nn.Dense(
             self.features, dtype=self.compute_dtype,
@@ -180,7 +204,7 @@ class TrajectoryEncoder(nn.Module):
                 new_cache.append(c_i)
                 x = x + a
             else:
-                x = x + attn(h)
+                x = x + attn(h, replicate_ok=replicate_ok)
             h = nn.LayerNorm(dtype=self.compute_dtype, name=f"ln_m{i}")(x)
             h = nn.Dense(
                 4 * self.features, dtype=self.compute_dtype,
@@ -225,7 +249,8 @@ class TrajectoryPPOModel(nn.Module):
     cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
 
     @nn.compact
-    def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None):
+    def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None,
+                 replicate_ok: bool = False):
         from surreal_tpu.models.ppo_net import PolicyOutput
 
         cfg = self.encoder_cfg
@@ -240,7 +265,7 @@ class TrajectoryPPOModel(nn.Module):
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
             h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)
         else:
-            h = trunk(_obs_dtype(obs_seq))
+            h = trunk(_obs_dtype(obs_seq), replicate_ok=replicate_ok)
         mean = nn.Dense(
             self.act_dim, kernel_init=orthogonal_init(0.01),
             param_dtype=jnp.float32, name="mean",
@@ -272,7 +297,8 @@ class TrajectoryCategoricalPPOModel(nn.Module):
     cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
 
     @nn.compact
-    def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None):
+    def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None,
+                 replicate_ok: bool = False):
         from surreal_tpu.models.ppo_net import CategoricalOutput
 
         cfg = self.encoder_cfg
@@ -287,7 +313,7 @@ class TrajectoryCategoricalPPOModel(nn.Module):
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
             h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)
         else:
-            h = trunk(_obs_dtype(obs_seq))
+            h = trunk(_obs_dtype(obs_seq), replicate_ok=replicate_ok)
         logits = nn.Dense(
             self.n_actions, kernel_init=orthogonal_init(0.01),
             param_dtype=jnp.float32, name="logits",

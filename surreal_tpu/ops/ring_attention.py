@@ -120,7 +120,9 @@ def ring_attention(
     that, not for zero.
     """
     B, T, H, D = q.shape
-    n = jax.lax.axis_size(axis_name)
+    from surreal_tpu.utils.compat import axis_size
+
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(D, jnp.float32))
 
@@ -178,7 +180,8 @@ def _ring_jit(mesh, axis: str, causal: bool, remat: bool, batch_axis):
     jit cache and recompile every eager invocation (Mesh is hashable, so
     it keys the cache directly)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from surreal_tpu.utils.compat import shard_map
 
     spec = P(batch_axis, axis)
     attend = shard_map(

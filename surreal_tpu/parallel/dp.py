@@ -16,9 +16,9 @@ from typing import Any
 
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from surreal_tpu.learners.base import Learner
+from surreal_tpu.utils.compat import shard_map
 
 
 def _spec_like(tree: Any, spec: P) -> Any:

@@ -74,3 +74,24 @@ def can_sample(size: jax.Array, start_sample_size: int) -> jax.Array:
     """The reference's ``start_sample_condition`` (min fill before the
     learner may draw)."""
     return size >= start_sample_size
+
+
+# -- telemetry gauges (SURVEY.md §5.5: tensorplex tracked replay occupancy;
+# the rebuild computes the gauges IN-GRAPH as device scalars that ride the
+# metrics dict, syncing to host only at the metrics cadence) ----------------
+
+def ring_gauges(state: RingState, capacity: int) -> dict:
+    """Occupancy gauges for a ring buffer: absolute fill and fraction."""
+    size = state.size.astype(jnp.float32)
+    return {"replay/size": size, "replay/fill": size / capacity}
+
+
+def sample_age_frac(state: RingState, idx: jax.Array, capacity: int) -> jax.Array:
+    """Mean staleness of a sampled index batch, as a fraction of the
+    current fill: 0 = just written, ~1 = the oldest transitions held.
+    Ring age is distance behind the newest write, modulo wraparound."""
+    newest = (state.cursor - 1) % capacity
+    age = (newest - idx) % capacity
+    return age.astype(jnp.float32).mean() / jnp.maximum(
+        state.size.astype(jnp.float32), 1.0
+    )

@@ -19,7 +19,15 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
-from surreal_tpu.replay.base import RingState, can_sample, init_ring, ring_gather, ring_insert
+from surreal_tpu.replay.base import (
+    RingState,
+    can_sample,
+    init_ring,
+    ring_gather,
+    ring_gauges,
+    ring_insert,
+    sample_age_frac,
+)
 
 
 class PrioritizedState(NamedTuple):
@@ -86,6 +94,17 @@ class PrioritizedReplay:
 
         batch = ring_gather(state.ring, idx)
         return state, batch, {"idx": idx, "is_weights": weights}
+
+    # -- telemetry gauges (device scalars; see replay/base.py) ---------------
+    def gauges(self, state: PrioritizedState) -> dict:
+        # callers reading max_priority after the dp pmax see the global one
+        return dict(
+            ring_gauges(state.ring, self.capacity),
+            **{"replay/max_priority": state.max_priority},
+        )
+
+    def age_frac(self, state: PrioritizedState, idx: jax.Array) -> jax.Array:
+        return sample_age_frac(state.ring, idx, self.capacity)
 
     def update_priorities(
         self, state: PrioritizedState, idx: jax.Array, td_errors: jax.Array

@@ -72,7 +72,7 @@ def sharded_replay_init(replay, example: Any, mesh: Mesh, axis: str = "dp") -> A
     insert-divergent scalar (prioritized max_priority) is re-synced with a
     pmax inside the training step (see OffPolicyTrainer._device_train_iter).
     """
-    from jax import shard_map
+    from surreal_tpu.utils.compat import shard_map
 
     local = jax.eval_shape(replay.init, example)
     out_specs = jax.tree.map(

@@ -8,7 +8,15 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from surreal_tpu.replay.base import RingState, can_sample, init_ring, ring_gather, ring_insert
+from surreal_tpu.replay.base import (
+    RingState,
+    can_sample,
+    init_ring,
+    ring_gather,
+    ring_gauges,
+    ring_insert,
+    sample_age_frac,
+)
 
 
 class UniformReplay:
@@ -35,3 +43,10 @@ class UniformReplay:
         idx = jax.random.randint(key, (bs,), 0, jnp.maximum(state.size, 1))
         batch = ring_gather(state, idx)
         return state, batch, {"idx": idx}
+
+    # -- telemetry gauges (device scalars; see replay/base.py) ---------------
+    def gauges(self, state: RingState) -> dict:
+        return ring_gauges(state, self.capacity)
+
+    def age_frac(self, state: RingState, idx: jax.Array) -> jax.Array:
+        return sample_age_frac(state, idx, self.capacity)

@@ -139,6 +139,23 @@ BASE_SESSION_CONFIG = Config(
         tensorboard=True,
         console=True,
     ),
+    telemetry=Config(
+        # telemetry spine (session/telemetry.py): span tracing into an
+        # append-only JSONL event log under <folder>/telemetry/, mirrored
+        # as time/* scalars through the MetricsWriter. Spans accumulate
+        # in-memory and are written as ONE 'phases' event per metrics
+        # cadence, so log volume scales with metrics.every_n_iters, not
+        # iteration rate; the in-graph health/* diagnostics
+        # (learners/base.py::training_health) ride the metrics dict and
+        # sync at the same cadence — the hot loop gains zero extra
+        # device->host syncs (tests/test_telemetry.py proves it).
+        # Read a session offline with `python -m surreal_tpu diag <folder>`.
+        enabled=True,
+        # multi-host runs: each rank appends liveness events to its own
+        # telemetry/heartbeat_rank<k>.jsonl at this cadence (seconds);
+        # ranks whose host cannot write the folder disable silently
+        heartbeat_every_s=10.0,
+    ),
     eval=Config(
         every_n_iters=100,
         episodes=5,

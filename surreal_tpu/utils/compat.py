@@ -1,0 +1,48 @@
+"""Version-compat shims for the jax pinned on the running image.
+
+``shard_map`` moved twice across the jax versions this repo meets: on
+0.4.x it lives in ``jax.experimental.shard_map`` and the replication
+check is spelled ``check_rep``; newer jax exports it at top level with
+the check renamed ``check_vma``. Every product call site imports the
+wrapper below (house signature = the new one) so the codebase reads
+modern while still running on the older pin.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _LEGACY_SHARD_MAP = False
+except ImportError:  # jax 0.4.x: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY_SHARD_MAP = True
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` with the modern signature on any supported jax."""
+    if _LEGACY_SHARD_MAP:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma,
+    )
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mapped axis (``jax.lax.axis_size`` on new
+    jax; 0.4.x spells it ``core.axis_frame``, which returns the bare int
+    inside shard_map)."""
+    import jax
+
+    try:
+        return int(jax.lax.axis_size(axis_name))
+    except AttributeError:  # jax 0.4.x
+        from jax._src import core
+
+        frame = core.axis_frame(axis_name)
+        return int(frame if isinstance(frame, int) else frame.size)

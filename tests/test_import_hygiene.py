@@ -72,6 +72,41 @@ def test_package_import_initializes_no_backend():
     assert n_modules > 30, f"walk found only {n_modules} modules"
 
 
+_JITTED_STEP_SOURCES = (
+    # packages whose modules contain (or are traced into) jitted step code
+    "learners", "ops", "replay", "models", "parallel", "envs/jax",
+    # single files on the jitted path
+    "launch/rollout.py",
+)
+_FENCE_BANNED = ("time.time(", "time.perf_counter(", "block_until_ready(")
+
+
+def test_no_host_clocks_or_fences_in_jitted_step_modules():
+    """Fence-discipline lint (the round-5 landmines, now enforced): a host
+    clock inside a module traced into the jitted step runs ONCE at compile
+    and lies forever, and ``jax.block_until_ready`` both serializes the
+    async pipeline and does not actually wait on this image's tunneled
+    backend (the ~1000x pre-round-3 timing inflation). Wall-clock
+    measurement belongs to utils/timer.py and session/telemetry.py, at
+    phase boundaries only. The substring scan includes call parens so
+    prose mentions in docstrings stay legal; the code itself must not
+    call these."""
+    bad = []
+    for entry in _JITTED_STEP_SOURCES:
+        root = _PKG_ROOT / entry
+        files = [root] if root.suffix == ".py" else sorted(root.rglob("*.py"))
+        for path in files:
+            src = path.read_text()
+            for banned in _FENCE_BANNED:
+                if banned in src:
+                    bad.append(f"{path.relative_to(_REPO_ROOT)}: {banned}")
+    assert not bad, (
+        "host clock / fence calls inside jitted-step modules "
+        "(move timing to utils/timer.py or session/telemetry.py):\n"
+        + "\n".join(bad)
+    )
+
+
 def test_graft_entry_import_initializes_no_backend():
     """__graft_entry__ itself must also be import-clean: the driver imports
     it before calling dryrun_multichip, which is where platform selection

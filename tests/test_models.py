@@ -129,6 +129,33 @@ def test_trajectory_encoder_sp_matches_single_device():
         )
 
 
+def test_ring_batch_indivisible_learn_shape_raises():
+    """ADVICE r5 low: on a dp x sp mesh, a NON-trivial batch (B>1, T>1)
+    that does not divide the batch axis must raise instead of silently
+    replicating (the quiet perf cliff); the known tiny-batch callers —
+    init's [1, 1, obs] dummy and the evaluator's B=1 episode — still fall
+    back to replication. Model-side twin of the Trainer's
+    check_dp_divisible."""
+    import numpy as np
+    import pytest
+    from jax.sharding import Mesh
+
+    from surreal_tpu.models.attention import TrajectoryEncoder
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    enc = TrajectoryEncoder(
+        mesh=mesh, batch_axis="dp", compute_dtype=jnp.float32
+    )
+    obs_ok = jnp.zeros((1, 1, 10), jnp.float32)  # init dummy: replicates
+    params = enc.init(jax.random.key(0), obs_ok)
+    enc.apply(params, jnp.zeros((1, 8, 10), jnp.float32))  # B=1 eval: ok
+    with pytest.raises(ValueError, match="not divisible"):
+        enc.apply(params, jnp.zeros((3, 8, 10), jnp.float32))  # 3 % 2 != 0
+    # acting callers (padded act over an eval batch of any width) opt into
+    # the replication fallback explicitly — seq_policy.py passes this
+    enc.apply(params, jnp.zeros((3, 8, 10), jnp.float32), replicate_ok=True)
+
+
 def test_trajectory_encoder_is_causal():
     """Changing a LATER timestep must not change earlier outputs."""
     import numpy as np

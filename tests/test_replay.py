@@ -99,6 +99,7 @@ def test_sharded_replay_per_device_buffers():
     land in per-device storage (the ShardedReplay capability)."""
     from jax.sharding import PartitionSpec as P
     from surreal_tpu.parallel.mesh import make_mesh
+    from surreal_tpu.utils.compat import shard_map
 
     mesh = make_mesh(Config(mesh=Config(dp=8)))
     replay = build_replay(replay_cfg("uniform", capacity=16, batch_size=4, start_sample_size=1))
@@ -113,7 +114,7 @@ def test_sharded_replay_per_device_buffers():
         return new._replace(cursor=new.cursor[None], size=new.size[None])
 
     sharded_insert = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_device,
             mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(), state), jax.tree.map(lambda _: P("dp"), data)),

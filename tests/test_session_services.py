@@ -93,6 +93,61 @@ def test_metrics_writer_disabled_backends_are_noop(tmp_path, capsys):
     assert capsys.readouterr().out == ""
 
 
+def test_metrics_writer_nan_drop_per_key_and_all_nan_row(tmp_path, capsys):
+    """NaN scalars (windows with no finished episodes) drop PER KEY: the
+    finite keys of the same row still flow, and an all-NaN row writes
+    nothing rather than crashing."""
+    w = MetricsWriter(str(tmp_path), tensorboard=False, console=True)
+    w.write(5, {"episode/return": float("nan"), "loss/pg": 2.0})
+    out = capsys.readouterr().out
+    assert "loss/pg=2" in out and "episode/return" not in out
+    w.write(6, {"episode/return": float("nan")})  # all-NaN row: no crash
+    assert "[6]" in capsys.readouterr().out  # row printed, no values
+    w.close()
+
+
+def test_metrics_writer_degrades_without_tensorboard(tmp_path, monkeypatch, caplog):
+    """Headless images (no tensorboard package) must still train: with the
+    import marked failed, tensorboard=True degrades to a no-op backend
+    with ONE warning instead of raising."""
+    import logging
+
+    import surreal_tpu.session.metrics as M
+
+    monkeypatch.setattr(M, "_TB_IMPORT_ERROR", ImportError("no tensorboard"))
+    with caplog.at_level(logging.WARNING, logger="surreal_tpu"):
+        w = M.MetricsWriter(str(tmp_path), tensorboard=True, console=False)
+    assert w._tb is None
+    assert any("tensorboard" in r.message for r in caplog.records)
+    w.write(1, {"a": 1.0})  # no crash, no event files
+    w.flush()
+    w.close()
+    assert glob.glob(str(tmp_path / "tb" / "**" / "events.*")) == []
+
+
+def test_get_logger_retargets_file_handler_across_sessions(tmp_path):
+    """Sequential sessions in one process must never cross-write logs: a
+    get_logger call with a NEW folder closes the old file handler and
+    retargets, and re-calls with the same folder add no handlers."""
+    from surreal_tpu.session.metrics import get_logger
+
+    f1, f2 = tmp_path / "s1", tmp_path / "s2"
+    log = get_logger("retarget_probe", str(f1))
+    log.info("first-session line")
+    log2 = get_logger("retarget_probe", str(f2))
+    assert log2 is log  # same logger object, retargeted
+    log.info("second-session line")
+    for h in log.handlers:
+        h.flush()
+    t1 = (f1 / "logs" / "retarget_probe.log").read_text()
+    t2 = (f2 / "logs" / "retarget_probe.log").read_text()
+    assert "first-session line" in t1 and "second-session line" not in t1
+    assert "second-session line" in t2 and "first-session line" not in t2
+    n = len(log.handlers)
+    get_logger("retarget_probe", str(f2))  # idempotent per (name, folder)
+    assert len(log.handlers) == n
+
+
 # -- evaluator --------------------------------------------------------------
 
 def test_evaluator_device_env_returns_full_episode_stats():
