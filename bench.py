@@ -73,7 +73,7 @@ def _iter_flops(jitted, *args) -> float | None:
         return None
 
 
-def main() -> None:
+def _measure() -> dict:
     from surreal_tpu.launch.trainer import Trainer
     from surreal_tpu.session.config import Config
     from surreal_tpu.session.default_configs import base_config
@@ -129,12 +129,85 @@ def main() -> None:
         "value": round(sps, 1),
         "unit": "env_steps/s/chip",
         "vs_baseline": round(sps / NORTH_STAR, 3),
+        # the device actually measured: jax can silently fall back to CPU
+        # when the TPU backend fails to init mid-outage, and a CPU number
+        # must never masquerade as the per-chip record
+        "device": str(jax.devices()[0].device_kind),
+        "platform": str(jax.devices()[0].platform),
     }
     if flops_per_iter is not None:
         achieved = flops_per_iter * MEASURE_ITERS / dt
         result["model_flops_per_s"] = round(achieved, 1)
         result["mfu"] = round(achieved / PEAK_FLOPS_BF16, 6)
-    print(json.dumps(result))
+    return result
+
+
+# error signatures of a TPU backend-init outage (the round-5 event: the
+# tunneled backend refused to come up and bench died rc=1 with a raw
+# traceback, leaving NO artifact for the round). Word-bounded regex, not
+# bare substrings: 'tpu' must not match inside 'output', or a
+# deterministic shape error would burn three compile cycles before the
+# artifact lands. Deterministic failures (bad import, config typo, shape
+# error) match none of these and are NOT retried — they would repeat.
+_BACKEND_INIT_RETRYABLE = (
+    r"\btpu\b", r"backend", r"\bunavailable\b", r"deadline.?exceeded",
+    r"failed to (connect|initialize)", r"connection (refused|reset)",
+    r"no visible device", r"\bplugin\b",
+)
+RETRY_ATTEMPTS = 3
+RETRY_BACKOFF_S = 10.0
+
+
+def _is_retryable(err: BaseException) -> bool:
+    import re
+
+    msg = f"{type(err).__name__}: {err}".lower()
+    return any(re.search(sig, msg) for sig in _BACKEND_INIT_RETRYABLE)
+
+
+def _reset_backends() -> None:
+    """Drop jax's cached backend-discovery result so a retry genuinely
+    re-attempts TPU init — xla_bridge latches _backends/_backend_errors
+    on first use and short-circuits every later call, so without this a
+    'retry' either re-raises the cached error instantly or silently
+    measures on the CPU fallback. Best-effort across jax pins."""
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+    except Exception:
+        try:
+            jax.clear_backends()
+        except Exception:
+            pass
+
+
+def main() -> int:
+    """Measure with bounded retry/backoff on backend-init outages; on
+    exhaustion (or a non-retryable failure) print the driver's structured
+    failed-round artifact ({"error": ..., "parsed": null} — the shape
+    perf_report.newest_bench_artifact already skips over) and exit 0, so
+    an outage yields a parseable record instead of a raw-traceback rc=1."""
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            print(json.dumps(_measure()))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"bench attempt {attempt + 1}/{RETRY_ATTEMPTS} failed "
+                    f"({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    print(json.dumps({"error": err, "parsed": None}))
+    return 0
 
 
 if __name__ == "__main__":

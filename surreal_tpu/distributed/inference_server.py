@@ -160,19 +160,32 @@ class InferenceServer:
         if not requests:
             return
         t0 = time.monotonic()
-        obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
+        if len(requests) == 1:
+            # fast path (the steady state at min_batch=1): a lone pending
+            # request needs no concatenate into a scratch batch and no
+            # re-slice back out — act on the worker's array directly and
+            # ship the results whole. Record-identical to the batched
+            # path below (slice 0:n of a 1-request batch IS the batch).
+            obs = requests[0][1]["obs"]
+        else:
+            obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
         with self._act_lock:
             actions, info = self._act_fn(obs)
             info = dict(info, param_version=np.full(len(obs), self._version, np.int32))
         actions = np.asarray(actions)
         info = {k: np.asarray(v) for k, v in info.items()}
-        offset = 0
-        for ident, msg in requests:
-            n = msg["obs"].shape[0]
-            sl = slice(offset, offset + n)
-            offset += n
-            self._record(ident, msg, actions[sl], {k: v[sl] for k, v in info.items()})
-            self._sock.send_multipart([ident, pickle.dumps(actions[sl], protocol=5)])
+        if len(requests) == 1:
+            ident, msg = requests[0]
+            self._record(ident, msg, actions, info)
+            self._sock.send_multipart([ident, pickle.dumps(actions, protocol=5)])
+        else:
+            offset = 0
+            for ident, msg in requests:
+                n = msg["obs"].shape[0]
+                sl = slice(offset, offset + n)
+                offset += n
+                self._record(ident, msg, actions[sl], {k: v[sl] for k, v in info.items()})
+                self._sock.send_multipart([ident, pickle.dumps(actions[sl], protocol=5)])
         ms = (time.monotonic() - t0) * 1e3
         self._serve_ms_ewma = (
             ms if self._serve_ms_ewma is None

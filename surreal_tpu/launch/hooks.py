@@ -30,6 +30,26 @@ from surreal_tpu.session.telemetry import Tracer
 from surreal_tpu.session.tracker import PeriodicTracker
 
 
+def maybe_enable_compile_cache(session_cfg) -> str | None:
+    """Resolve + enable ``session.compile_cache_dir`` (the persistent XLA
+    compile cache); returns the active absolute dir, or None when the knob
+    is unset or enabling failed. Relative paths resolve under the session
+    folder, so the default spelling ``compile_cache_dir=xla_cache`` keeps
+    the cache session-local while an absolute path shares one cache across
+    sessions (the warm-relaunch win). One function for every caller:
+    SessionHooks (all single-host drivers + multi-host rank 0) and the
+    multi-host prologue for ranks > 0, which never construct hooks.
+    ``.get`` keeps configs saved before the knob existed loadable."""
+    cache_dir = session_cfg.get("compile_cache_dir", None)
+    if not cache_dir:
+        return None
+    if not os.path.isabs(cache_dir):
+        cache_dir = os.path.join(session_cfg.folder, cache_dir)
+    from surreal_tpu.utils.compat import enable_compile_cache
+
+    return cache_dir if enable_compile_cache(cache_dir) else None
+
+
 class SessionHooks:
     """One per training run. Driver contract:
 
@@ -72,6 +92,14 @@ class SessionHooks:
             enabled=bool(tel.enabled) if tel is not None else True,
             name=name,
         )
+        # persistent XLA compile cache: enabled before the driver's first
+        # jitted call compiles (drivers construct hooks inside run(), and
+        # tracing/compilation is lazy until the first dispatch)
+        self.compile_cache_dir = maybe_enable_compile_cache(cfg)
+        if self.compile_cache_dir is not None:
+            self.log.info(
+                "persistent compile cache at %s", self.compile_cache_dir
+            )
         self.ckpt: CheckpointManager | None = make_checkpoint_manager(cfg)
         self._ckpt_every = PeriodicTracker(max(1, cfg.checkpoint.every_n_iters))
         # optional step-aligned auxiliary state (the off-policy trainer
@@ -267,6 +295,7 @@ class SessionHooks:
                 time.time() - (self._t0 or time.time()), 1e-9
             )
             self._last_train = m
+            self._emit_cache_event()
         if self._publisher is not None and self._pub_every.track_increment():
             with self.tracer.span("param-publish", emit=True):
                 version = self._publisher.publish(
@@ -319,6 +348,20 @@ class SessionHooks:
             if self.extra_state_fn is not None:
                 self.ckpt.save_extra(iteration, self.extra_state_fn())
 
+    def _emit_cache_event(self) -> None:
+        """Mirror the compile-cache hit/miss counters into the telemetry
+        log (one 'compile_cache' event per metrics cadence + one at close;
+        `surreal_tpu diag` reports the last one). Host-side ints only —
+        no device sync rides on this."""
+        if self.compile_cache_dir is None:
+            return
+        from surreal_tpu.utils.compat import compile_cache_counts
+
+        self.tracer.event(
+            "compile_cache", dir=self.compile_cache_dir,
+            **compile_cache_counts(),
+        )
+
     def _profiler_tick(self, iteration: int) -> None:
         if not self._prof_enabled:
             return
@@ -358,6 +401,7 @@ class SessionHooks:
         if self.ckpt is not None:
             self.ckpt.close()
         self.writer.close()
+        self._emit_cache_event()  # final counts for runs shorter than a cadence
         self.tracer.close()
 
 

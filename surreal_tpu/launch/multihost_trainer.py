@@ -138,6 +138,7 @@ class _MultiHostSession:
             self._agree_fn = jax.jit(
                 lambda x: jnp.minimum(jnp.sum(x), 1),
                 out_shardings=NamedSharding(self.mesh, P()),
+                donate_argnums=(0,),  # flags are rebuilt fresh every call
             )
         n_local = len([d for d in self.mesh.devices.flat if d.process_index == self.rank])
         local = np.full(
@@ -180,6 +181,13 @@ class _MultiHostSession:
         mesh -> start counters. Returns (hooks, state, iteration,
         env_steps); hooks is None on ranks > 0."""
         hooks = SessionHooks(self.config, self.learner) if self.rank == 0 else None
+        if hooks is None:
+            # ranks > 0 never construct hooks, but every process compiles
+            # the same programs — enable the persistent compile cache here
+            # (ranks without the folder mounted degrade to cold compiles)
+            from surreal_tpu.launch.hooks import maybe_enable_compile_cache
+
+            maybe_enable_compile_cache(self.config.session_config)
         try:
             iteration, env_steps = 0, 0
             if hooks is not None:
@@ -295,6 +303,7 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                         self.env, k, self.global_num_envs
                     ),
                     out_shardings=NamedSharding(self.mesh, P("dp")),
+                    donate_argnums=(),  # one-shot init; nothing loop-carried
                 )(env_key)
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
@@ -441,7 +450,10 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
                 offpolicy_carry_specs(carry_shapes, "dp"),
                 is_leaf=lambda x: isinstance(x, P),
             )
-            carry = jax.jit(self._init_carry, out_shardings=carry_sh)(env_key)
+            carry = jax.jit(
+                self._init_carry, out_shardings=carry_sh,
+                donate_argnums=(),  # one-shot init; nothing loop-carried
+            )(env_key)
             # replay shards allocate per-device via shard_map (SPMD too)
             replay_state = sharded_replay_init(
                 self.replay, self._replay_example(), self.mesh
@@ -537,6 +549,10 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
             self.mesh.shape["dp"],
             what="num_envs * process_count",
         )
+        # donation is SAFE here, unlike single-host SEED: every rank's
+        # inference server acts from its own host-local ``_act_base``
+        # copy (params+obs_stats grafts), never from the globally-sharded
+        # train state this learn donates
         self._learn = dp_learn(self.learner, self.mesh)
 
     def _worker_env_config(self, env_cfg):

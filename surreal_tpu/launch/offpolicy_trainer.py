@@ -8,7 +8,12 @@ carried-OU exploration noise), n-step folding, replay insert, and
 — into ONE jitted function: the off-policy analogue of Trainer's fused
 on-policy iteration. Replay warmup is a ``lax.cond`` (skip updates until
 ``start_sample_size``), so the compiled program is identical across the
-warmup boundary.
+warmup boundary. The fused program donates its loop-carried pytrees
+(state, replay shards, env carry) so XLA updates their HBM in place.
+
+Host mode double-buffers: the exploration rollout + its host->device
+staging run on a prefetch thread while the device drains the SGD updates
+(see ``_run_host``).
 """
 
 from __future__ import annotations
@@ -97,18 +102,39 @@ class OffPolicyTrainer:
                 )
             else:
                 self.replay = build_replay(self.learner.config.replay)
-                self._train_iter = jax.jit(self._device_train_iter)
+                # donate the loop-carried state / replay shards / env
+                # carry: XLA reuses their HBM (the replay storage is the
+                # program's largest allocation) instead of holding two
+                # copies live across the fused iteration; run() never
+                # reads a pre-iteration reference again
+                self._train_iter = jax.jit(
+                    self._device_train_iter, donate_argnums=(0, 1, 2)
+                )
         else:
             self.replay = build_replay(self.learner.config.replay)
-            self._act = jax.jit(self.learner.act, static_argnames="mode")
-            self._learn = jax.jit(self.learner.learn)
-            self._insert = jax.jit(self.replay.insert)
-            self._sample = jax.jit(self.replay.sample)
+            # acting reuses the same state every env step: never donate
+            self._act = jax.jit(
+                self.learner.act, static_argnames="mode", donate_argnums=()
+            )
+            # NOT donated: the overlapped host loop's staging thread acts
+            # from the latest published state — the very buffers a
+            # donating learn would invalidate mid-rollout
+            self._learn = jax.jit(self.learner.learn, donate_argnums=())
+            # replay state is loop-carried on the train thread only:
+            # donate it through insert/sample/priority-refresh so the
+            # host path updates the buffer in place too
+            self._insert = jax.jit(self.replay.insert, donate_argnums=(0,))
+            self._sample = jax.jit(self.replay.sample, donate_argnums=(0,))
+            # NOT donated: at n_step=1 `full` IS the rollout traj, which
+            # update_obs_stats still reads after the fold
             self._nstep = jax.jit(
-                lambda traj: nstep_transitions(traj, algo.gamma, algo.n_step)
+                lambda traj: nstep_transitions(traj, algo.gamma, algo.n_step),
+                donate_argnums=(),
             )
             if self.prioritized:
-                self._update_prio = jax.jit(self.replay.update_priorities)
+                self._update_prio = jax.jit(
+                    self.replay.update_priorities, donate_argnums=(0,)
+                )
 
     # -- device (fused) path -------------------------------------------------
     def _init_carry(self, env_key: jax.Array) -> OffPolicyCarry:
@@ -341,6 +367,22 @@ class OffPolicyTrainer:
 
                 state = replicate_state(self.mesh, state)
             carry = self._init_carry(env_key)
+            if self.mesh is not None and self.mesh.size > 1:
+                # commit the carry with the shard_map's own specs at init
+                # (same reason as Trainer.run: an uncommitted carry breaks
+                # the first iteration's donation and pays a reshard)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                from surreal_tpu.parallel.dp import offpolicy_carry_specs
+
+                carry = jax.device_put(
+                    carry,
+                    jax.tree.map(
+                        lambda spec: NamedSharding(self.mesh, spec),
+                        offpolicy_carry_specs(carry),
+                        is_leaf=lambda x: isinstance(x, P),
+                    ),
+                )
             example = self._replay_example()
             if self.mesh is not None and self.mesh.size > 1:
                 from surreal_tpu.replay.sharded import sharded_replay_init
@@ -399,11 +441,23 @@ class OffPolicyTrainer:
 
     # -- host path -----------------------------------------------------------
     def _run_host(self, total, on_metrics, hooks, state, iteration, env_steps):
+        """Host-env loop. With ``topology.overlap_rollouts`` (default on)
+        the exploration rollout + its host->device staging run on a
+        prefetch thread (learners/prefetch.py): while the device drains
+        chunk k's ``updates_per_iter`` SGD steps, the staging thread
+        simulates chunk k+1 and ships it as ONE ``device_put`` — iteration
+        wall-clock ~max(rollout, updates) instead of their sum. The
+        staging thread acts from the latest PUBLISHED state — with one
+        chunk queued and one mid-collection, up to TWO iterations behind
+        (off-policy by construction, the same bounded staleness the
+        replay already serves; the warmup flag shares the bound);
+        ``overlap_rollouts=false`` restores strict collect->update
+        alternation with zero policy lag."""
         steps_per_iter = self.horizon * self.num_envs
         act_dim = int(self.env.specs.action.shape[0])
 
-        key = jax.random.key(self.seed + 1)
-        obs = self.env.reset(seed=self.config.env_config.seed)
+        base_key = jax.random.key(self.seed + 1)
+        key = jax.random.fold_in(base_key, 0)  # update/sample chain
         replay_state = self.replay.init(self._replay_example())
         ckpt_cfg = self.config.session_config.checkpoint
         if ckpt_cfg.get("include_replay", False) and hooks.ckpt is not None:
@@ -415,7 +469,6 @@ class OffPolicyTrainer:
                 )
                 if restored is not None:
                     replay_state = restored["replay"]
-        noise = np.zeros((self.num_envs, act_dim), np.float32)
         explo = self.algo.exploration
         n = self.algo.n_step
         if n > 1:
@@ -435,27 +488,53 @@ class OffPolicyTrainer:
         from collections import deque
 
         from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
+        from surreal_tpu.learners.prefetch import Prefetcher
 
         recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
-        first_chunk = True
-        while env_steps < total:
+
+        # rollout-side mutable state, owned by whichever thread runs
+        # collect_chunk (the staging thread under overlap, this one
+        # otherwise — never both); the holders publish the acting state
+        # and consumed-step count across the seam
+        roll = {
+            "key": jax.random.fold_in(base_key, 1),
+            "obs": self.env.reset(seed=self.config.env_config.seed),
+            "noise": np.zeros((self.num_envs, act_dim), np.float32),
+        }
+        act_holder = [state]
+        steps_holder = [env_steps]
+
+        def collect_chunk():
+            """One H-step exploration rollout, stacked time-major and
+            shipped to device as one transfer. Returns (device_traj,
+            completed-episode returns) — the returns ride the staged item
+            so only the MAIN thread touches recent_returns (extending it
+            from this thread would race host_metrics' iteration of the
+            deque, the same hazard trainer.py's overlap collector routes
+            through its queue)."""
             steps = []
-            warmup = env_steps < explo.warmup_steps
+            chunk_returns = []
+            obs, noise = roll["obs"], roll["noise"]
+            a_state = act_holder[0]  # one coherent policy per chunk
+            warmup = steps_holder[0] < explo.warmup_steps
             with hooks.tracer.span("rollout"):
                 for _ in range(self.horizon):
-                    key, akey, nkey = jax.random.split(key, 3)
+                    roll["key"], akey, nkey = jax.random.split(roll["key"], 3)
                     if warmup:
                         action = np.random.default_rng(
                             int(jax.random.randint(akey, (), 0, 2**31 - 1))
                         ).uniform(-1.0, 1.0, (self.num_envs, act_dim)).astype(np.float32)
                     elif explo.noise == "ou":
-                        a_det, _ = self._act(state, jnp.asarray(obs), akey, mode="eval_deterministic")
-                        noise = np.asarray(
+                        a_det, _ = self._act(a_state, jnp.asarray(obs), akey, mode="eval_deterministic")
+                        # np.array (copy), NOT np.asarray: asarray of a jax
+                        # array is a read-only view, and the episode-reset
+                        # masking below writes into it
+                        noise = np.array(
                             ou_noise_step(jnp.asarray(noise), nkey, explo.ou_theta, explo.sigma, explo.ou_dt)
                         )
                         action = np.clip(np.asarray(a_det) + noise, -1.0, 1.0)
                     else:
-                        a, _ = self._act(state, jnp.asarray(obs), akey, mode="training")
+                        a, _ = self._act(a_state, jnp.asarray(obs), akey, mode="training")
                         action = np.asarray(a)
                     out = self.env.step(action)
                     term_obs = out.info.get("terminal_obs", out.obs)
@@ -474,58 +553,91 @@ class OffPolicyTrainer:
                     if out.done.any():
                         noise[out.done] = 0.0
                     if "episode_returns" in out.info:
-                        recent_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
+                        chunk_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
                     obs = out.obs
-            traj = {k: jnp.asarray(np.stack([s[k] for s in steps])) for k in steps[0]}
-            if host_tail is not None:
-                full = jax.tree.map(
-                    lambda a, b: jnp.concatenate([a, b], axis=0), host_tail, traj
+            roll["obs"], roll["noise"] = obs, noise
+            with hooks.tracer.span("h2d-transfer"):
+                return (
+                    jax.device_put(
+                        {k: np.stack([s[k] for s in steps]) for k in steps[0]}
+                    ),
+                    chunk_returns,
                 )
-                host_tail = jax.tree.map(
-                    lambda x: x[-(self.algo.n_step - 1):], full
-                )
-            else:
-                full = traj
-            trans = self._nstep(full)
-            if host_tail is not None and first_chunk:
-                # same scrub as the device path: the run's first prepended
-                # tail is fabricated, so its windows must not enter replay
-                trans = scrub_fake_prefix_windows(
-                    trans, self.algo.n_step, self.num_envs
-                )
-            first_chunk = False
-            with hooks.tracer.span("replay-insert"):
-                replay_state = self._insert(replay_state, trans)
-            state = self.learner.update_obs_stats(state, traj["obs"])
-            if bool(self.replay.can_sample(replay_state)):
-                beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
-                for _ in range(self.algo.updates_per_iter):
-                    key, skey = jax.random.split(key)
-                    with hooks.tracer.span("replay-sample"):
+
+        overlap = bool(
+            self.config.session_config.topology.get("overlap_rollouts", True)
+        )
+        prefetch = (
+            Prefetcher(collect_chunk, name="offpolicy-stage") if overlap else None
+        )
+        first_chunk = True
+        try:
+            while env_steps < total:
+                if prefetch is not None:
+                    with hooks.tracer.span("chunk-wait"):
+                        traj, ep_returns = prefetch.get()
+                else:
+                    # no chunk-wait span: collect_chunk records its own
+                    # rollout/h2d phases, and wrapping it here would count
+                    # the same wall time twice in the diag breakdown
+                    traj, ep_returns = collect_chunk()
+                recent_returns.extend(ep_returns)
+                if host_tail is not None:
+                    full = jax.tree.map(
+                        lambda a, b: jnp.concatenate([a, b], axis=0), host_tail, traj
+                    )
+                    host_tail = jax.tree.map(
+                        lambda x: x[-(self.algo.n_step - 1):], full
+                    )
+                else:
+                    full = traj
+                trans = self._nstep(full)
+                if host_tail is not None and first_chunk:
+                    # same scrub as the device path: the run's first prepended
+                    # tail is fabricated, so its windows must not enter replay
+                    trans = scrub_fake_prefix_windows(
+                        trans, self.algo.n_step, self.num_envs
+                    )
+                first_chunk = False
+                with hooks.tracer.span("replay-insert"):
+                    replay_state = self._insert(replay_state, trans)
+                state = self.learner.update_obs_stats(state, traj["obs"])
+                if bool(self.replay.can_sample(replay_state)):
+                    beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
+                    for _ in range(self.algo.updates_per_iter):
+                        key, skey = jax.random.split(key)
+                        with hooks.tracer.span("replay-sample"):
+                            if self.prioritized:
+                                replay_state, batch, info = self._sample(replay_state, skey, beta=beta)
+                                batch = dict(batch, is_weights=info["is_weights"])
+                            else:
+                                replay_state, batch, info = self._sample(replay_state, skey)
+                        with hooks.tracer.span("learn"):
+                            state, metrics = self._learn(state, batch, skey)
+                        td_abs = metrics.pop("priority/td_abs")
                         if self.prioritized:
-                            replay_state, batch, info = self._sample(replay_state, skey, beta=beta)
-                            batch = dict(batch, is_weights=info["is_weights"])
-                        else:
-                            replay_state, batch, info = self._sample(replay_state, skey)
-                    with hooks.tracer.span("learn"):
-                        state, metrics = self._learn(state, batch, skey)
-                    td_abs = metrics.pop("priority/td_abs")
-                    if self.prioritized:
-                        replay_state = self._update_prio(replay_state, info["idx"], td_abs)
-                metrics["replay/sample_age_frac"] = self.replay.age_frac(
-                    replay_state, info["idx"]
+                            replay_state = self._update_prio(replay_state, info["idx"], td_abs)
+                    metrics["replay/sample_age_frac"] = self.replay.age_frac(
+                        replay_state, info["idx"]
+                    )
+                else:
+                    metrics = {}
+                metrics = dict(metrics, **self.replay.gauges(replay_state))
+                # publish the updated acting state + consumed-step count to
+                # the staging thread (its next chunk explores with them)
+                act_holder[0] = state
+                iteration += 1
+                env_steps += steps_per_iter
+                steps_holder[0] = env_steps
+                key, hk_key = jax.random.split(key)
+                _, stop = hooks.end_iteration(
+                    iteration, env_steps, state, hk_key,
+                    host_metrics(metrics, recent_returns), on_metrics,
                 )
-            else:
-                metrics = {}
-            metrics = dict(metrics, **self.replay.gauges(replay_state))
-            iteration += 1
-            env_steps += steps_per_iter
-            key, hk_key = jax.random.split(key)
-            _, stop = hooks.end_iteration(
-                iteration, env_steps, state, hk_key,
-                host_metrics(metrics, recent_returns), on_metrics,
-            )
-            if stop:
-                break
-        hooks.final_checkpoint(iteration, env_steps, state)
-        return state, hooks.last_metrics
+                if stop:
+                    break
+            hooks.final_checkpoint(iteration, env_steps, state)
+            return state, hooks.last_metrics
+        finally:
+            if prefetch is not None:
+                prefetch.close()

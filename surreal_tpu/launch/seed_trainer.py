@@ -5,9 +5,10 @@ SURVEY.md §3.2 with the actor pool collapsed).
 
 Data flow:
   env workers --ZMQ/DCN--> InferenceServer (one batched policy forward)
-     └─ trajectory chunks --queue--> learner.learn (V-trace corrects the
-        one-update staleness; works for IMPALA and, with staleness caveats,
-        PPO)
+     └─ trajectory chunks --queue--> staging thread (double-buffered
+        host->device transfer, learners/prefetch.py) --> learner.learn
+        (V-trace corrects the one-update staleness; works for IMPALA
+        and, with staleness caveats, PPO)
 
 Workers run as threads (fine for gym classic-control) or OS processes
 (``worker_mode='process'`` — MuJoCo-heavy stepping releases the GIL
@@ -60,16 +61,25 @@ class _DataPlane:
         self._timeout = first_timeout
         self.steady_timeout = 30.0
         self.last_chunk_age_s = 0.0  # queue dwell of the last chunk served
+        # supervision runs from the prefetch staging thread (empty-poll
+        # waits) AND the trainer thread (drop path / post-learn): without
+        # the lock both could respawn the same dead worker
+        self._supervise_lock = threading.Lock()
 
     def supervise(self) -> None:
-        self.respawns += self.trainer._respawn_dead_workers(
-            self.workers, self.env_cfg, self.server.address, self.stop
-        )
+        with self._supervise_lock:
+            self.respawns += self.trainer._respawn_dead_workers(
+                self.workers, self.env_cfg, self.server.address, self.stop
+            )
 
     def next_chunk(self) -> dict:
         deadline = time.monotonic() + self._timeout
         self._timeout = self.steady_timeout
         while True:
+            if self.stop.is_set():
+                # teardown: the staging thread must not sit out its full
+                # chunk timeout against a closed server
+                raise TimeoutError("data plane stopped") from None
             try:
                 chunk = self.server.chunks.get(timeout=2.0)
                 # queue-latency gauge: how long the chunk waited for the
@@ -154,7 +164,10 @@ class SEEDTrainer:
             max_staleness = self.algo.get("max_staleness", None)
         self.max_staleness = max_staleness
 
-        self._jit_act = jax.jit(self.learner.act, static_argnames="mode")
+        # acting reuses the same state every serve: never donate
+        self._jit_act = jax.jit(
+            self.learner.act, static_argnames="mode", donate_argnums=()
+        )
         # multi-chip learner: an EXPLICIT dp axis (topology.mesh.dp > 1;
         # the -1 "use everything" default stays single-device here because
         # SEED batch width is set by num_envs, which must divide dp) runs
@@ -181,9 +194,15 @@ class SEEDTrainer:
                 config.session_config.topology,
                 devices=jax.devices()[: dp * tp],
             )
-            self._learn = dp_learn(self.learner, self.mesh)
+            # donate=False: the inference server's act_fn closure aliases
+            # the live train state and serves from it CONCURRENTLY with
+            # the next learn — a donating learn would invalidate buffers
+            # mid-serve (the multi-host SEED subclass acts from a separate
+            # host-local copy, but shares this builder)
+            self._learn = dp_learn(self.learner, self.mesh, donate=False)
         else:
-            self._learn = jax.jit(self.learner.learn)
+            # NOT donated — same aliasing as above (see dp_learn's note)
+            self._learn = jax.jit(self.learner.learn, donate_argnums=())
 
     def _spawn_one(self, i: int, env_cfg, address, stop):
         """Start env worker ``i`` as a thread or subprocess.
@@ -301,6 +320,7 @@ class SEEDTrainer:
 
         hooks = SessionHooks(self.config, self.learner)
         plane = None
+        prefetch = None
         stop = threading.Event()
         try:
             state, iteration, env_steps = hooks.restore(state)
@@ -321,6 +341,36 @@ class SEEDTrainer:
             server = plane.server
             self._workers = plane.workers  # exposed for tests/fault injection
 
+            # double-buffered staging (learners/prefetch.py): the staging
+            # thread waits on the chunk queue AND pays the host->device
+            # transfer for chunk k+1 while the learner crunches chunk k —
+            # with the dp-committed sharding, so the jitted learn never
+            # reshards. param_version stays HOST-side (the staleness
+            # decision needs it before any device work would be useful).
+            from surreal_tpu.learners.prefetch import Prefetcher
+
+            def stage_next_chunk():
+                chunk = plane.next_chunk()
+                versions = chunk.pop("param_version")
+                n_steps = int(
+                    chunk["reward"].shape[0] * chunk["reward"].shape[1]
+                )
+                with hooks.tracer.span("h2d-transfer"):
+                    if self.mesh is not None:
+                        # split host->devices directly along the dp-sharded
+                        # batch dim; a plain device_put would commit the
+                        # whole chunk to device 0 and reshard inside the jit
+                        from surreal_tpu.parallel.mesh import batch_sharded
+
+                        batch = jax.device_put(
+                            chunk, batch_sharded(self.mesh, batch_dim=1)
+                        )
+                    else:
+                        batch = jax.device_put(chunk)
+                return batch, versions, n_steps
+
+            prefetch = Prefetcher(stage_next_chunk, name="seed-stage")
+
             dropped_stale = 0
             discarded_steps = 0
 
@@ -339,8 +389,7 @@ class SEEDTrainer:
 
             while env_steps < total:
                 with hooks.tracer.span("chunk-wait"):
-                    chunk = plane.next_chunk()
-                versions = chunk.pop("param_version")
+                    batch, versions, n_steps = prefetch.get()
                 staleness = server.version - int(versions.min())
                 # Accounting contract: trainer-side stale DROPS count into
                 # env_steps (deterministic, the trainer chose to discard);
@@ -354,32 +403,21 @@ class SEEDTrainer:
                     # acted by a too-old policy: drop, don't train. The
                     # steps DID happen — count them, and keep supervising
                     # workers (a streak of stale chunks must not pause
-                    # respawn or stretch wall-clock past the step budget)
+                    # respawn or stretch wall-clock past the step budget).
+                    # The prefetcher already paid this chunk's transfer —
+                    # a bounded waste (drops are the exception path).
                     dropped_stale += 1
-                    n_dropped = chunk["reward"].shape[0] * chunk["reward"].shape[1]
-                    env_steps += n_dropped
-                    discarded_steps += n_dropped
+                    env_steps += n_steps
+                    discarded_steps += n_steps
                     plane.supervise()
                     continue
-                with hooks.tracer.span("h2d-transfer"):
-                    if self.mesh is not None:
-                        # split host->devices directly along the dp-sharded
-                        # batch dim; a plain device_put would commit the
-                        # whole chunk to device 0 and reshard inside the jit
-                        from surreal_tpu.parallel.mesh import batch_sharded
-
-                        batch = jax.device_put(
-                            chunk, batch_sharded(self.mesh, batch_dim=1)
-                        )
-                    else:
-                        batch = jax.device_put(chunk)
                 key, lkey, hk_key = jax.random.split(key, 3)
                 with hooks.tracer.span("learn"):
                     state, metrics = self._learn(state, batch, lkey)
                 with hooks.tracer.span("param-publish"):
                     server.set_act_fn(self._make_act_fn(state, key_holder))
                 iteration += 1
-                env_steps += chunk["reward"].shape[0] * chunk["reward"].shape[1]
+                env_steps += n_steps
                 plane.supervise()
                 metrics = dict(
                     metrics,
@@ -401,6 +439,8 @@ class SEEDTrainer:
             return state, hooks.last_metrics
         finally:
             stop.set()
+            if prefetch is not None:
+                prefetch.close()
             if plane is not None:
                 plane.close()
             hooks.close()

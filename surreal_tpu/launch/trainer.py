@@ -112,7 +112,12 @@ class Trainer:
                     self._sp_carry_sharding = batch_sharded(self.mesh, "dp")
                 else:
                     self.learner.rebind_mesh(self.mesh, "sp")
-                self._train_iter = jax.jit(self._device_train_iter)
+                # donate the loop-carried state + env carry: XLA reuses
+                # their HBM across iterations instead of double-buffering
+                # (run() never reads a pre-iteration reference again)
+                self._train_iter = jax.jit(
+                    self._device_train_iter, donate_argnums=(0, 1)
+                )
             elif self.mesh.size > 1:
                 from surreal_tpu.parallel.dp import dp_train_iter
                 from surreal_tpu.parallel.mesh import check_dp_divisible
@@ -122,7 +127,10 @@ class Trainer:
                     self._device_train_iter, self.learner, self.mesh
                 )
             else:
-                self._train_iter = jax.jit(self._device_train_iter)
+                # same donation as the sp path (see comment above)
+                self._train_iter = jax.jit(
+                    self._device_train_iter, donate_argnums=(0, 1)
+                )
         else:
             if getattr(self.learner, "requires_act_carry", False):
                 raise ValueError(
@@ -131,8 +139,14 @@ class Trainer:
                     "sequence context carry"
                 )
             self.mesh = None
-            self._act = jax.jit(partial(self.learner.act, mode="training"))
-            self._learn = jax.jit(self.learner.learn)
+            # acting reuses the same state every env step: never donate
+            self._act = jax.jit(
+                partial(self.learner.act, mode="training"), donate_argnums=()
+            )
+            # NOT donated: the overlapped host loop's collector thread
+            # acts from act_state[0] — the very state a donating learn
+            # would invalidate while a rollout is mid-flight with it
+            self._learn = jax.jit(self.learner.learn, donate_argnums=())
 
     # -- device (fused) path -------------------------------------------------
     def _device_train_iter(
@@ -204,6 +218,15 @@ class Trainer:
                     # carry leaves lead with the env dim) so rollout work
                     # splits over dp instead of replicating
                     carry = jax.device_put(carry, self._sp_carry_sharding)
+                elif self.mesh is not None and self.mesh.size > 1:
+                    # commit the carry dp-sharded at init so it matches
+                    # the fused iter's in/out shardings from the FIRST
+                    # call: an uncommitted carry forces a reshard whose
+                    # source buffers cannot alias the output, silently
+                    # dropping the donation for iteration 1
+                    from surreal_tpu.parallel.mesh import batch_sharded
+
+                    carry = jax.device_put(carry, batch_sharded(self.mesh))
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
                     # span is UNFENCED (dispatch time): fencing here would

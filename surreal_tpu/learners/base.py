@@ -102,6 +102,15 @@ class Learner(abc.ABC):
         """One SGD iteration. Pure; jit/shard_map-safe.
 
         Returns (new_state, metrics dict of scalars).
+
+        Donation contract (the dispatch pipeline's HBM-reuse invariant):
+        drivers jit this with ``donate_argnums=(0,)`` wherever the state
+        is loop-carried — state-in and state-out are shape-identical, so
+        XLA updates the buffers in place. Implementations must therefore
+        never stash ``state`` (or leaves of it) on ``self`` or in any
+        closure that outlives the call; callers that keep the state
+        aliased elsewhere (SEED's live act closure) jit with
+        ``donate_argnums=()`` instead — see parallel/dp.py::dp_learn.
         """
 
     # -- acting --------------------------------------------------------------

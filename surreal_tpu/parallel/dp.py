@@ -25,9 +25,18 @@ def _spec_like(tree: Any, spec: P) -> Any:
     return jax.tree.map(lambda _: spec, tree)
 
 
-def dp_learn(learner: Learner, mesh: Mesh, axis: str = "dp"):
+def dp_learn(learner: Learner, mesh: Mesh, axis: str = "dp", donate: bool = True):
     """Build a jitted data-parallel ``learn``: (state, batch, key) ->
-    (state, metrics), batch sharded on dim 1 (time-major [T, B, ...])."""
+    (state, metrics), batch sharded on dim 1 (time-major [T, B, ...]).
+
+    ``donate`` (default on) donates the train state's HBM to its
+    successor — state-in and state-out are shape/sharding-identical, so
+    XLA updates in place instead of holding both copies live across the
+    step. Donation contract: the caller must not touch the passed state
+    after dispatch (reuse raises "Array has been deleted"). Callers whose
+    state stays aliased elsewhere pass donate=False — the SEED trainer's
+    inference server serves from a closure over the live state while the
+    next learn runs."""
 
     def step(state, batch, key):
         return learner.learn(state, batch, key, axis_name=axis)
@@ -46,7 +55,7 @@ def dp_learn(learner: Learner, mesh: Mesh, axis: str = "dp"):
         )
         return shard(state, batch, key)
 
-    return jax.jit(wrapped)
+    return jax.jit(wrapped, donate_argnums=(0,) if donate else ())
 
 
 def _spec_like_metrics(spec: P):
@@ -116,7 +125,12 @@ def dp_offpolicy_iter(trainer_iter, mesh: Mesh, axis: str = "dp"):
         )
         return shard(state, replay_state, carry, key, beta, warmup, first)
 
-    return jax.jit(wrapped)
+    # train state, replay shards, and env carry are all loop-carried
+    # (shape/sharding-identical in and out): donate all three so the
+    # fused iteration updates HBM in place — the replay storage alone is
+    # the largest allocation in the program, and an undonated iteration
+    # would hold two full copies live across every step
+    return jax.jit(wrapped, donate_argnums=(0, 1, 2))
 
 
 def dp_train_iter(trainer_iter, learner: Learner, mesh: Mesh, axis: str = "dp"):
@@ -152,4 +166,5 @@ def dp_train_iter(trainer_iter, learner: Learner, mesh: Mesh, axis: str = "dp"):
         )
         return shard(state, carry, key)
 
-    return jax.jit(wrapped)
+    # state and env carry are loop-carried: donate both (see dp_learn)
+    return jax.jit(wrapped, donate_argnums=(0, 1))

@@ -122,6 +122,16 @@ BASE_SESSION_CONFIG = Config(
         ),
     ),
     total_env_steps=1_000_000,
+    # persistent XLA compile cache (utils/compat.py::enable_compile_cache,
+    # wired by SessionHooks so every driver — single- and multi-host —
+    # shares it): a directory for jax_compilation_cache_dir. Relative
+    # paths resolve under the session folder; None disables. Relaunching
+    # a session (or any session pointed at the same absolute dir) reuses
+    # the compiled executables instead of re-paying XLA compile time —
+    # WALLCLOCK_r05 measured compile, not train time, as the dominant
+    # spread on the pong workload. Hit/miss counts flow as
+    # 'compile_cache' telemetry events (surfaced by `surreal_tpu diag`).
+    compile_cache_dir=None,
     checkpoint=Config(
         every_n_iters=500,
         keep_last=3,

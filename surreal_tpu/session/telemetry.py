@@ -34,6 +34,9 @@ line, ``t`` = unix seconds):
         {"<phase>": {"count": N, "total_s": S, "max_ms": M}}}
     {"type": "span",      "t": ..., "name": "...", "dur_s": ...}
     {"type": "metrics",   "t": ..., "step": ..., "values": {...}}
+    {"type": "compile_cache", "t": ..., "dir": "...", "hits": H,
+     "misses": M}   (cumulative; written by SessionHooks when
+                     session.compile_cache_dir is active)
 
 Heartbeats live per rank in ``telemetry/heartbeat_rank<k>.jsonl``:
 
@@ -261,6 +264,7 @@ def diag_summary(folder: str) -> dict | None:
 
     phases: dict[str, dict] = {}
     health: dict[str, dict] = {}
+    compile_cache = None
     nonfinite_windows = 0
     t_first = t_last = None
     last_step = None
@@ -280,6 +284,13 @@ def diag_summary(folder: str) -> dict | None:
                 agg["count"] += int(st.get("count", 0))
                 agg["total_s"] += float(st.get("total_s", 0.0))
                 agg["max_ms"] = max(agg["max_ms"], float(st.get("max_ms", 0.0)))
+        elif ev.get("type") == "compile_cache":
+            # counters are cumulative; the last event is the session total
+            compile_cache = {
+                "dir": ev.get("dir"),
+                "hits": int(ev.get("hits", 0)),
+                "misses": int(ev.get("misses", 0)),
+            }
         elif ev.get("type") == "metrics":
             last_step = ev.get("step", last_step)
             vals = ev.get("values") or {}
@@ -316,6 +327,7 @@ def diag_summary(folder: str) -> dict | None:
         "last_step": last_step,
         "phases": phases,
         "health": health,
+        "compile_cache": compile_cache,
         "nonfinite_windows": nonfinite_windows,
         "heartbeats": heartbeats,
     }
@@ -355,6 +367,18 @@ def diag_report(folder: str) -> str | None:
         )
     else:
         lines.append("  (no phase windows recorded)")
+    cc = s.get("compile_cache")
+    if cc is not None:
+        total = cc["hits"] + cc["misses"]
+        lines += [
+            "",
+            f"Compile cache — {cc.get('dir')}",
+            f"  {cc['hits']} hits / {cc['misses']} misses"
+            + (
+                f" ({100.0 * cc['hits'] / total:.0f}% warm)"
+                if total else ""
+            ),
+        ]
     lines += ["", "Training health"]
     if s["health"]:
         lines.append(

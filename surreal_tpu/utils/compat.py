@@ -46,3 +46,85 @@ def axis_size(axis_name) -> int:
 
         frame = core.axis_frame(axis_name)
         return int(frame if isinstance(frame, int) else frame.size)
+
+
+# -- persistent XLA compile cache ---------------------------------------------
+# The flag spelling moved across jax versions (jax_compilation_cache_dir has
+# been stable, but the persistent-cache eligibility knobs appeared later and
+# the hit/miss counters live behind the private monitoring module), so the
+# enabling + counting both route through here: product code sees one call
+# that works on any supported pin and degrades to a no-op instead of raising.
+
+_CACHE_COUNTS = {"hits": 0, "misses": 0}
+_CACHE_LISTENER_INSTALLED = False
+
+
+def _install_cache_listener() -> None:
+    """Count compile-cache hits/misses via jax's monitoring events (the
+    pinned jax records '/jax/compilation_cache/cache_{hits,misses}').
+    Private API — failure to install just leaves the counts at zero."""
+    global _CACHE_LISTENER_INSTALLED
+    if _CACHE_LISTENER_INSTALLED:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _listener(event, **kwargs):
+            if event.endswith("/cache_hits"):
+                _CACHE_COUNTS["hits"] += 1
+            elif event.endswith("/cache_misses"):
+                _CACHE_COUNTS["misses"] += 1
+
+        monitoring.register_event_listener(_listener)
+        _CACHE_LISTENER_INSTALLED = True
+    except Exception:
+        pass
+
+
+def compile_cache_counts() -> dict:
+    """Process-global compile-cache hit/miss counts since the listener was
+    installed (zeros when enable_compile_cache never ran / succeeded)."""
+    return dict(_CACHE_COUNTS)
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point jax's persistent XLA compile cache at ``cache_dir`` and relax
+    the eligibility thresholds so every program caches (an RL session
+    compiles a handful of LARGE programs — the fused train iteration is
+    minutes of XLA time on a real chip — so there is nothing worth
+    filtering out). Creates the directory; returns False (leaving the
+    cache off) on any failure, because a missing cache must degrade to a
+    cold compile, never kill training."""
+    import os
+
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except (OSError, AttributeError, ValueError):
+        return False
+    # eligibility knobs are best-effort per pin: the dir alone enables the
+    # cache with that pin's defaults when a knob spelling is missing
+    for flag, value in (
+        ("jax_enable_compilation_cache", True),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    ):
+        try:
+            jax.config.update(flag, value)
+        except (AttributeError, ValueError):
+            pass
+    # the pinned jax latches an is-the-cache-used decision at the FIRST
+    # compile of the process (compilation_cache._cache_checked) — and the
+    # drivers compile key-derivation programs before SessionHooks enables
+    # the cache, which would latch it off for the whole run. reset_cache()
+    # clears the latch so the dir set above actually takes effect.
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    _install_cache_listener()
+    return True

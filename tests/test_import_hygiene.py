@@ -107,6 +107,64 @@ def test_no_host_clocks_or_fences_in_jitted_step_modules():
     )
 
 
+_DONATION_SCOPED_SOURCES = (
+    # learner/trainer step modules: every jax.jit here is on (or adjacent
+    # to) a training hot loop where the loop-carried state should be
+    # donated — and where accidental donation of an aliased state (the
+    # SEED act closure, the overlap collector's acting reference) is a
+    # use-after-free. Either way the decision must be explicit.
+    "learners", "parallel/dp.py",
+    "launch/trainer.py", "launch/offpolicy_trainer.py",
+    "launch/seed_trainer.py", "launch/multihost_trainer.py",
+)
+
+
+def _jit_call_spans(src: str):
+    """(line_number, call_text) for every ``jax.jit(`` call, text spanning
+    to the balanced closing paren (strings/comments not parsed — good
+    enough for a lint over our own style)."""
+    spans = []
+    start = 0
+    while True:
+        i = src.find("jax.jit(", start)
+        if i < 0:
+            return spans
+        depth = 0
+        for j in range(i + len("jax.jit"), len(src)):
+            if src[j] == "(":
+                depth += 1
+            elif src[j] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+        spans.append((src.count("\n", 0, i) + 1, src[i : j + 1]))
+        start = j + 1
+
+
+def test_jitted_steps_declare_donation():
+    """Donation-discipline lint (the dispatch-pipeline PR's invariant): a
+    new ``jax.jit`` in a learner/trainer step module without an explicit
+    ``donate_argnums`` either misses the HBM win (an undonated train state
+    is double-buffered every iteration) or — worse — gets donation bolted
+    on later without auditing the aliases. Every call must state its
+    decision: donate the loop-carried args, or ``donate_argnums=()`` with
+    a comment naming the alias that forbids it."""
+    bad = []
+    for entry in _DONATION_SCOPED_SOURCES:
+        root = _PKG_ROOT / entry
+        files = [root] if root.suffix == ".py" else sorted(root.rglob("*.py"))
+        for path in files:
+            for line, call in _jit_call_spans(path.read_text()):
+                if "donate_argnums" not in call:
+                    bad.append(f"{path.relative_to(_REPO_ROOT)}:{line}")
+    assert not bad, (
+        "jax.jit calls in learner/trainer step modules without an explicit "
+        "donate_argnums (donate the loop-carried state, or declare "
+        "donate_argnums=() and comment why the buffers stay aliased):\n"
+        + "\n".join(bad)
+    )
+
+
 def test_graft_entry_import_initializes_no_backend():
     """__graft_entry__ itself must also be import-clean: the driver imports
     it before calling dryrun_multichip, which is where platform selection

@@ -211,6 +211,84 @@ def test_fused_train_iter_no_syncs_off_metrics_cadence(tmp_path):
         hooks.close()
 
 
+def test_prefetch_staging_adds_no_device_to_host_syncs(tmp_path):
+    """Transfer-guard proof for the dispatch pipeline's staging seam
+    (learners/prefetch.py): pulling double-buffered chunks — numpy
+    stacking + jax.device_put on the staging thread, exactly what the
+    SEED trainer and the off-policy host loop stage — and consuming them
+    through a jitted step is pure host->device traffic. The guard runs on
+    BOTH sides of the seam, so a device_get smuggled into either the
+    producer or the consumer loop raises."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def produce():
+        with jax.transfer_guard_device_to_host("disallow"):
+            chunk = {
+                "obs": rng.normal(size=(4, 8, 3)).astype(np.float32),
+                "reward": rng.normal(size=(4, 8)).astype(np.float32),
+            }
+            return jax.device_put(chunk)
+
+    from surreal_tpu.learners.prefetch import Prefetcher
+
+    consume = jax.jit(
+        lambda b: b["obs"].sum() + b["reward"].sum(), donate_argnums=()
+    )
+    # warm the compile outside the guard (compilation may transfer)
+    jax.block_until_ready(consume(produce()))
+
+    p = Prefetcher(produce)
+    try:
+        outs = []
+        with jax.transfer_guard_device_to_host("disallow"):
+            for _ in range(4):
+                outs.append(consume(p.get()))
+        # the ONE sync happens after the guarded window, as in the drivers
+        assert all(np.isfinite(jax.device_get(o)) for o in outs)
+    finally:
+        p.close()
+
+
+def test_offpolicy_host_loop_staged_overlap_trains(tmp_path):
+    """The off-policy HOST loop with overlap_rollouts on (the default):
+    the staging thread collects + device_puts chunks while the main
+    thread updates; the run must produce finite metrics, fill replay, and
+    count its env-step budget exactly — and the strict-alternation mode
+    must behave identically. The budget runs PAST the env's 200-step
+    episode limit so the OU episode-reset masking executes (it writes
+    into the noise array — a read-only asarray view crashed here)."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    for overlap in (True, False):
+        cfg = Config(
+            learner_config=Config(
+                algo=Config(name="ddpg", horizon=8, updates_per_iter=1,
+                            exploration=Config(warmup_steps=8)),
+                replay=Config(capacity=1024, start_sample_size=32, batch_size=16),
+            ),
+            env_config=Config(name="gym:Pendulum-v1", num_envs=2),
+            session_config=Config(
+                folder=str(tmp_path / f"host_ov_{overlap}"),
+                total_env_steps=8 * 2 * 27,  # 216 steps/env > the 200 limit
+                topology=Config(overlap_rollouts=overlap),
+                metrics=Config(every_n_iters=1, tensorboard=False,
+                               console=False),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+            ),
+        ).extend(base_config())
+        trainer = OffPolicyTrainer(cfg)
+        assert not trainer.device_mode
+        state, metrics = trainer.run()
+        assert metrics["time/env_steps"] == 8 * 2 * 27, overlap
+        assert metrics["replay/size"] >= 32, overlap
+        for k, v in metrics.items():
+            if k.startswith(("loss/", "health/")):
+                assert v == v, (overlap, k)  # NaN guard
+
+
 def test_offpolicy_fused_iter_no_syncs_off_metrics_cadence(tmp_path):
     """Same guarantee for the off-policy fused iteration, which
     additionally carries the replay occupancy/staleness gauges in-graph."""
