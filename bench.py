@@ -184,10 +184,17 @@ def _reset_backends() -> None:
 
 def main() -> int:
     """Measure with bounded retry/backoff on backend-init outages; on
+    ``--host-path``, delegate to the host data-plane campaign
+    (perf_wallclock.host_path_main — SEED trainer at the PERF.md
+    dm_control geometry, BENCH_host.json artifact) instead; otherwise on
     exhaustion (or a non-retryable failure) print the driver's structured
     failed-round artifact ({"error": ..., "parsed": null} — the shape
     perf_report.newest_bench_artifact already skips over) and exit 0, so
     an outage yields a parseable record instead of a raw-traceback rc=1."""
+    if "--host-path" in sys.argv:
+        from perf_wallclock import host_path_main
+
+        return host_path_main(sys.argv[1:])
     err = None
     for attempt in range(RETRY_ATTEMPTS):
         try:

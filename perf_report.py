@@ -705,7 +705,6 @@ def host_env_cheetah():
     from surreal_tpu.session.default_configs import base_config
 
     num_envs, horizon = 32, 64
-    steps_per_iter = num_envs * horizon
 
     def _cfg(folder, overlap, workers=0, worker_envs=None):
         return Config(
@@ -782,41 +781,36 @@ def host_env_cheetah():
     # -- whole-trainer wall-clock, three drive modes ------------------------
     WARM_ITERS, MEAS_ITERS = 3, 12
 
-    def timed_run(trainer_cls, config, per_iter_steps):
+    def timed_run(trainer_cls, config):
         trainer = trainer_cls(config)
-        times = []
+        marks = []  # (t, env_steps): measured steps, not an assumed
+        # per-iteration width (SEED chunk width halves under pipelining)
 
         def on_m(it, m):
-            times.append(time.perf_counter())
-            return len(times) >= WARM_ITERS + MEAS_ITERS
+            marks.append((time.perf_counter(), m["time/env_steps"]))
+            return len(marks) >= WARM_ITERS + MEAS_ITERS
 
         trainer.run(on_metrics=on_m)
         if hasattr(trainer, "env") and hasattr(trainer.env, "close"):
             trainer.env.close()
-        n = len(times) - WARM_ITERS
-        dt = times[-1] - times[WARM_ITERS - 1]
-        return n * per_iter_steps / dt, dt / n * 1e3
+        n = len(marks) - WARM_ITERS
+        (t0, s0), (t1, s1) = marks[WARM_ITERS - 1], marks[-1]
+        return (s1 - s0) / (t1 - t0), (t1 - t0) / n * 1e3
 
     folders = [tempfile.mkdtemp(prefix="perf_cheetah_") for _ in range(3)]
     try:
-        sps_alt, iter_alt = timed_run(
-            Trainer, _cfg(folders[0], overlap=False), steps_per_iter
-        )
+        sps_alt, iter_alt = timed_run(Trainer, _cfg(folders[0], overlap=False))
         print(json.dumps({"host_env_alternate_sps": sps_alt,
                           "iter_ms": iter_alt}, default=float))
-        sps_ovl, iter_ovl = timed_run(
-            Trainer, _cfg(folders[1], overlap=True), steps_per_iter
-        )
+        sps_ovl, iter_ovl = timed_run(Trainer, _cfg(folders[1], overlap=True))
         print(json.dumps({"host_env_overlap_sps": sps_ovl,
                           "iter_ms": iter_ovl}, default=float))
         from surreal_tpu.launch.seed_trainer import SEEDTrainer
 
-        # 4 worker processes x 8 envs = the same 32-env fleet, chunk
-        # geometry [horizon, 8] per worker
+        # 4 worker processes x 8 envs = the same 32-env fleet (chunk
+        # geometry [horizon, 4] per pipelined sub-slice)
         sps_seed, iter_seed = timed_run(
-            SEEDTrainer,
-            _cfg(folders[2], overlap=False, workers=4, worker_envs=8),
-            horizon * 8,
+            SEEDTrainer, _cfg(folders[2], overlap=False, workers=4, worker_envs=8)
         )
         print(json.dumps({"host_env_seed_sps": sps_seed,
                           "iter_ms": iter_seed}, default=float))
@@ -841,6 +835,106 @@ def host_env_cheetah():
             iter_alt if best == sps_alt else iter_seed
         ),
     }
+
+
+def _load_host_bench():
+    """Load the host data-plane artifact (`BENCH_host.json`, written by
+    `perf_wallclock.py --host-path` / `bench.py --host-path`) if present —
+    like block_vs_row.json, keeping it as an artifact lets PERF.md regens
+    preserve the measured section without re-running the campaign."""
+    try:
+        with open("BENCH_host.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or "value" not in data:
+        return None  # failed-round artifact ({"error": ..., "parsed": null})
+    return data
+
+
+def _host_data_plane_lines() -> list[str]:
+    """The 'Host data plane rebuild' PERF.md section: static mechanism
+    text plus the measured table from the BENCH_host.json artifact when
+    one exists. One function so `main()` and the standalone section
+    patcher cannot drift."""
+    lines = [
+        "",
+        "## Host data plane rebuild (zero-copy shm transport + pipelined "
+        "env workers)",
+        "",
+        "The SEED host path was rebuilt end to end "
+        "(`distributed/shm_transport.py`), attacking the 288 steps/s row "
+        "above — which paid a full pickle of the obs/reward/done dict, a "
+        "TCP round trip carrying those bytes, and an action re-pickle on "
+        "EVERY worker step, with each worker idle for the whole server "
+        "round trip:",
+        "",
+        "- **Zero-copy transport** — per-worker shared-memory slabs "
+        "(obs/reward/done/truncated/terminal_obs in, actions out) "
+        "negotiated at a hello handshake; afterwards ZMQ carries only "
+        "~20-byte control frames (slot index, flags, latency/occupancy "
+        "gauges, episode-stat floats). The server OWNS every segment — "
+        "created at hello, reused when a respawned worker re-negotiates "
+        "through ROUTER_HANDOVER, unlinked at close — so a SIGKILLed "
+        "worker cannot leak `/dev/shm` (tests assert this). The original "
+        "pickle wire remains the negotiated fallback (thread-mode tests, "
+        "remote workers), per worker and invisible to the trainer; a "
+        "record-equivalence test proves both transports assemble "
+        "byte-identical trajectory chunks for the same seed.",
+        "- **Pipelined workers** — `run_env_worker` splits its env slice "
+        "into two sub-slices and keeps one sub-slice's request in flight "
+        "while stepping the other (double-buffered acting, Stooke & "
+        "Abbeel 1803.02811), hiding the act round trip that the old "
+        "strictly-serial send→poll→step loop ate per step "
+        "(`topology.pipeline_workers`).",
+        "- **Copy-free server assembly + auto-tuned coalescing** — "
+        "`_serve_batch` reads worker slabs straight into one preallocated "
+        "scratch batch (no per-serve `np.concatenate`, no per-slice "
+        "pickling), writes action slices directly into each worker's "
+        "action slab, and retunes `min_batch`/`max_wait_ms` from the "
+        "live connected-worker count and its serve-latency EWMA, so the "
+        "fleet keeps coalescing into one forward per lockstep round "
+        "through worker death and respawn.",
+    ]
+    hostdp = _load_host_bench()
+    if hostdp:
+        shm_r, pkl_r = hostdp.get("shm", {}), hostdp.get("pickle", {})
+        lines += [
+            "",
+            f"Measured through the real SEED trainer at the record's "
+            f"geometry ({hostdp['geometry']}; `BENCH_host.json`, platform "
+            f"`{hostdp.get('platform')}`; warm iterations discarded):",
+            "",
+            "| Transport | env steps/s | wire bytes/step | iter ms |",
+            "|---|---|---|---|",
+            "| shm (negotiated; pipelined sub-slices) | "
+            f"{shm_r.get('env_steps_per_s', 0):,.0f} | "
+            f"{shm_r.get('transport', {}).get('wire_bytes_per_step', 0):,.1f} | "
+            f"{shm_r.get('iter_ms', 0):,.1f} |",
+            "| pickle fallback (same geometry) | "
+            f"{pkl_r.get('env_steps_per_s', 0):,.0f} | "
+            f"{pkl_r.get('transport', {}).get('wire_bytes_per_step', 0):,.1f} | "
+            f"{pkl_r.get('iter_ms', 0):,.1f} |",
+            "",
+            f"**{hostdp['vs_host_baseline']:.0f}x the 288 steps/s "
+            "round-5 record** with the shm transport active at the same "
+            "32-env x 64-horizon dm_control geometry. Honesty notes: "
+            "this artifact was measured on "
+            f"`{hostdp.get('platform')}` (no chip tunnel in the round), "
+            "and on this one-core box BOTH transports now saturate the "
+            "LEARNER, not the wire — their steps/s agree to within the "
+            "run-to-run spread (a cheaper send lets workers outrun the "
+            "saturated learner and burn the shared core on steps the "
+            "eviction path discards), and the transport's direct win "
+            "shows in the wire gauge (the bytes column: control frames "
+            "vs pickled arrays, "
+            f"~{pkl_r.get('transport', {}).get('wire_bytes_per_step', 0) / max(shm_r.get('transport', {}).get('wire_bytes_per_step', 1), 1e-9):,.0f}"
+            "x less traffic) and in the serve path doing zero "
+            "serialization work. The old 288 record was transport/latency"
+            "-bound; the rebuilt plane moved the bottleneck back to "
+            "compute, which is the point.",
+        ]
+    return lines
 
 
 def _load_block_vs_row():
@@ -1234,6 +1328,11 @@ def main(argv=None) -> None:
             "The numbers are honest for THIS box; the mode ranking the "
             "table records is the measured one.",
         ]
+    # static section + artifact table: the host data-plane rebuild is
+    # documented unconditionally (mechanism proven by test on this CPU
+    # image); the measured table rides the BENCH_host.json artifact so a
+    # regen without the campaign keeps the last measured numbers
+    lines += _host_data_plane_lines()
     if scaling:
         lines += [
             "",

@@ -9,6 +9,10 @@ parameter pub/sub for host consumers, and the binary wire format.
 
 from surreal_tpu.distributed.env_worker import run_env_worker
 from surreal_tpu.distributed.inference_server import InferenceServer
+from surreal_tpu.distributed.shm_transport import (
+    SlabSpec,
+    negotiate_worker_transport,
+)
 from surreal_tpu.distributed.module_dict import (
     ModuleDict,
     dumps_pytree,
@@ -24,6 +28,8 @@ from surreal_tpu.distributed.param_service import (
 __all__ = [
     "run_env_worker",
     "InferenceServer",
+    "SlabSpec",
+    "negotiate_worker_transport",
     "ModuleDict",
     "dumps_pytree",
     "loads_pytree",

@@ -6,17 +6,50 @@ Each worker steps a *vectorized slice* of host envs and ships one
 action batch back. Runs as a thread (tests, small runs) or a subprocess
 (real deployments — MuJoCo releases the GIL poorly); both use the same
 function.
+
+Data plane (shm_transport.py): the worker negotiates its transport at a
+hello handshake — a preallocated shared-memory slab when the server is
+local and grants it, the original pickle wire otherwise — and may split
+its env slice into two sub-slices, keeping one sub-slice's request in
+flight while stepping the other (the double-buffered acting of Stooke &
+Abbeel, 1803.02811). The steady-state loop therefore hides the server
+round trip behind env stepping instead of idling through it, and never
+touches the serializer when the slab transport is active.
 """
 
 from __future__ import annotations
 
-import pickle
 import threading
 import time
 from typing import Any
 
 import numpy as np
 import zmq
+
+from surreal_tpu.distributed import shm_transport as dp
+
+
+def _recv_reply(sock, stop_event, silence_s: float, steady: bool):
+    """Wait for one reply frame under the server-silence budget.
+
+    Returns the payload, or None when ``stop_event`` fires (set while we
+    wait on a server that already shut down — exit cleanly, don't raise).
+    Poll slices are 100 ms before the first-ever reply (the server's
+    first replies wait on XLA compiles — tens of seconds on a tunneled
+    TPU, and a stop request must still interrupt promptly) and coarsen to
+    500 ms in the steady state, where replies land in milliseconds and
+    the slice width only bounds stop-request latency.
+    """
+    slice_ms = 500 if steady else 100
+    deadline = time.monotonic() + silence_s
+    while not sock.poll(slice_ms):
+        if stop_event is not None and stop_event.is_set():
+            return None
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"inference server silent for {silence_s:.0f}s"
+            )
+    return sock.recv()
 
 
 def run_env_worker(
@@ -25,60 +58,96 @@ def run_env_worker(
     worker_id: int,
     max_steps: int | None = None,
     stop_event: threading.Event | None = None,
+    transport: str = "auto",
+    pipeline: bool = False,
+    server_silence_s: float = 120.0,
 ) -> int:
     """Step envs against the inference server until ``max_steps`` or
     ``stop_event``. Returns total env steps executed.
 
     Runs unchanged as a thread or a spawned subprocess; in the latter case
     ``env_config`` arrives as a plain picklable dict and is rehydrated.
+
+    ``transport``: 'auto' (negotiate shm against a local server, pickle
+    otherwise) | 'shm' (require the slab grant) | 'pickle'.
+    ``pipeline``: split the env slice into two sub-slices and keep one
+    sub-slice's request in flight while stepping the other.
+    ``server_silence_s``: per-step liveness budget (was a hard-coded 120 s).
     """
     from surreal_tpu.envs import make_env
     from surreal_tpu.session.config import Config
 
     env_config = Config(env_config)
-    env = make_env(env_config)
-    # every exit — stop request, timeout, socket-setup or env/pickle
-    # exception, normal end — must release the env and the DEALER socket:
-    # the supervisor respawns workers under the SAME identity, and a leaked
+    num_envs = int(env_config.num_envs)
+    n_slots = 2 if (pipeline and num_envs >= 2) else 1
+    widths = (
+        [num_envs] if n_slots == 1
+        else [num_envs - num_envs // 2, num_envs // 2]
+    )
+    # every exit — stop request, timeout, socket-setup or env exception,
+    # normal end — must release the envs and the DEALER socket: the
+    # supervisor respawns workers under the SAME identity, and a leaked
     # socket is exactly the stale connection ROUTER_HANDOVER must displace
     sock = None
+    envs: list = []
+    tr = None
     try:
         ctx = zmq.Context.instance()
         sock = ctx.socket(zmq.DEALER)
         sock.setsockopt(zmq.IDENTITY, f"worker-{worker_id}".encode())
         sock.connect(server_address)
 
-        obs = env.reset(seed=env_config.seed + worker_id)
-        msg: dict = {"obs": obs}
+        for s, w in enumerate(widths):
+            # seed decorrelation that also reaches adapters whose seeding
+            # is fixed at construction (dm_control). Adapters seed sub-env
+            # i as slot_seed + i, so slots/workers must stride by their
+            # ENV WIDTH (a stride of 1 would hand most envs in the fleet
+            # duplicated RNG streams): worker w's envs get the contiguous
+            # block [seed + w*num_envs, seed + (w+1)*num_envs).
+            slot_seed = (
+                int(env_config.seed) + worker_id * num_envs + sum(widths[:s])
+            )
+            envs.append(
+                make_env(Config(num_envs=w, seed=slot_seed).extend(env_config))
+            )
+        tr = dp.negotiate_worker_transport(
+            sock, transport, widths, envs[0].specs, server_address,
+            stop_event, timeout_s=server_silence_s,
+        )
+        if tr is None:
+            return 0  # stop requested mid-handshake
+
         steps = 0
-        act_latency_ms = None  # EWMA of the server round trip (telemetry)
-        while (max_steps is None or steps < max_steps) and not (
-            stop_event is not None and stop_event.is_set()
-        ):
-            t_send = time.monotonic()
-            sock.send(pickle.dumps(msg, protocol=5))
-            # poll in short slices so a stop request (set while we wait on
-            # a server that already shut down) exits cleanly instead of
-            # raising. The budget is generous because the server's first
-            # replies wait on XLA compiles (tens of seconds on a tunneled
-            # TPU).
-            for _ in range(1200):
-                if sock.poll(100):
-                    break
-                if stop_event is not None and stop_event.is_set():
-                    return steps
-            else:
-                raise TimeoutError(
-                    f"worker {worker_id}: inference server silent for 120s"
-                )
-            actions = pickle.loads(sock.recv())
-            rt_ms = (time.monotonic() - t_send) * 1e3
+        act_latency_ms = None   # EWMA of the server round trip (telemetry)
+        occupancy = 0.0         # EWMA: env-step time / (step + reply wait)
+        sent_at = [0.0] * n_slots
+        # prime every slot with its obs-only hello; from here exactly one
+        # request per slot is outstanding at all times, so while we step
+        # (or wait on) one sub-slice the other's round trip is in flight
+        for s in range(n_slots):
+            # first reset seeds from the slot config (adapters fall back
+            # to their construction seed when none is passed)
+            tr.send(s, {"obs": envs[s].reset()})
+            sent_at[s] = time.monotonic()
+        steady = False
+        while not (stop_event is not None and stop_event.is_set()):
+            t_wait0 = time.monotonic()
+            payload = _recv_reply(sock, stop_event, server_silence_s, steady)
+            if payload is None:
+                return steps
+            steady = True
+            slot, actions = tr.decode_reply(payload)
+            now = time.monotonic()
+            wait_s = now - t_wait0
+            rt_ms = (now - sent_at[slot]) * 1e3
             act_latency_ms = (
                 rt_ms if act_latency_ms is None
                 else 0.1 * rt_ms + 0.9 * act_latency_ms
             )
-            out = env.step(actions)
-            steps += env.num_envs
+            out = envs[slot].step(actions)
+            step_s = time.monotonic() - now
+            occupancy = 0.1 * (step_s / max(step_s + wait_s, 1e-9)) + 0.9 * occupancy
+            steps += envs[slot].num_envs
             msg = {
                 "obs": out.obs,
                 "reward": out.reward,
@@ -86,32 +155,45 @@ def run_env_worker(
                 "truncated": np.asarray(
                     out.info.get("truncated", np.zeros_like(out.done))
                 ),
-                "terminal_obs": out.info.get("terminal_obs", out.obs),
-                # round-trip latency rides with the next request so the
-                # server can expose a fleet-wide act-latency gauge
-                # (inference_server.queue_stats 'server/act_latency_ms')
+                # round-trip latency + pipeline occupancy ride with the
+                # next request so the server can expose fleet-wide gauges
+                # (inference_server.queue_stats 'server/act_latency_ms',
+                # 'server/pipeline_occupancy')
                 "act_latency_ms": act_latency_ms,
+                "pipeline_occupancy": occupancy,
             }
+            if out.done.any():
+                # only meaningful (and only shipped — an obs-sized copy
+                # per step otherwise) when an episode actually ended; the
+                # server's record path defaults terminal_obs to the step
+                # obs, which np.where ignores on no-done rows anyway
+                msg["terminal_obs"] = out.info.get("terminal_obs", out.obs)
             if "episode_returns" in out.info:
                 # completed-episode stats ride with the observations
                 # (SURVEY.md §5.5 — the reference's agents pushed these to
                 # tensorplex; here the server aggregates them)
                 msg["episode_returns"] = np.asarray(out.info["episode_returns"])
                 msg["episode_lengths"] = np.asarray(out.info["episode_lengths"])
-        # flush the final step's outcome (transition + any episode stats
-        # riding on it) fire-and-forget — without this the last env.step
-        # before a max_steps/stop exit would be silently lost. The 'final'
-        # tag tells the server not to act on it or install pending state
-        # for a worker that is about to be gone.
-        if "reward" in msg:
-            try:
-                sock.send(pickle.dumps(dict(msg, final=True), protocol=5), zmq.NOBLOCK)
-            except zmq.ZMQError:
-                pass
+            if max_steps is not None and steps >= max_steps:
+                # flush the final step's outcome (transition + any episode
+                # stats riding on it) fire-and-forget — without this the
+                # last env.step before exit would be silently lost. The
+                # 'final' tag tells the server not to act on it or install
+                # pending state for a worker that is about to be gone.
+                try:
+                    tr.send(slot, msg, final=True, noblock=True)
+                except zmq.ZMQError:
+                    pass
+                return steps
+            tr.send(slot, msg)
+            sent_at[slot] = time.monotonic()
         return steps
     finally:
+        if tr is not None:
+            tr.close()
         if sock is not None:
             # small linger so the final fire-and-forget flush actually
             # leaves the process (close(0) would discard queued sends)
             sock.close(100)
-        env.close()
+        for env in envs:
+            env.close()

@@ -4,22 +4,28 @@ style batched inference server where env.step observations are shipped to
 a single vmap'd policy.forward on-chip" — BASELINE.json; SURVEY.md §3.2).
 
 Shape: env workers (CPU processes/threads, each stepping a vectorized env
-slice) ship observation batches over ZMQ ROUTER/DEALER; the server
-micro-batches all pending requests into ONE policy forward, then routes
-per-worker action slices back. Behavior-policy info (``action_info``)
-stays server-side and is stitched with the rewards/dones arriving in the
-worker's NEXT request, accumulating time-major trajectory chunks for the
-learner — the ExperienceSender role (SURVEY.md §2.1) without a separate
-replay service hop.
+slice, optionally split into two pipelined sub-slices) ship observation
+batches via per-worker shared-memory slabs negotiated at a hello
+handshake (shm_transport.py) — ZMQ then carries only tiny control frames
+— or via the original pickle wire as the negotiated fallback. The server
+micro-batches all pending requests into ONE policy forward by reading
+worker slabs directly into a preallocated scratch batch (no per-serve
+``np.concatenate``, no per-slice pickling), writes action slices straight
+into each worker's action slab, and routes the control replies back.
+Behavior-policy info (``action_info``) stays server-side and is stitched
+with the rewards/dones arriving in that sub-slice's NEXT request,
+accumulating time-major trajectory chunks for the learner — the
+ExperienceSender role (SURVEY.md §2.1) without a separate replay hop.
 
-Serialization is pickle protocol 5 (the reference used pyarrow/pickle;
-workers are trusted local processes — this is an internal data plane, not
-an exposed endpoint).
+Serialization on the steady-state path: none under shm; pickle protocol 5
+under the fallback, decoded inside ``shm_transport`` (workers are trusted
+local processes — this is an internal data plane, not an exposed
+endpoint). ``tests/test_import_hygiene.py`` lints this module against
+ndarray pickling.
 """
 
 from __future__ import annotations
 
-import pickle
 import queue
 import threading
 import time
@@ -29,15 +35,36 @@ from typing import Any, Callable
 import numpy as np
 import zmq
 
+from surreal_tpu.distributed import shm_transport as dp
+
 
 class _WorkerTrack:
-    """Per-worker trajectory assembly state."""
+    """Per-(worker, slot) trajectory assembly state."""
 
     __slots__ = ("pending", "steps")
 
     def __init__(self):
         self.pending: dict | None = None  # {obs, action, info} awaiting outcome
         self.steps: list[dict] = []
+
+
+class _WorkerState:
+    """Per-identity transport state: negotiated slab + liveness stamp."""
+
+    __slots__ = ("slab", "spec", "views", "last_seen", "occupancy")
+
+    def __init__(self):
+        self.slab = None                    # SharedMemory (server-owned)
+        self.spec: dp.SlabSpec | None = None
+        self.views: list[dict] = []
+        self.last_seen = time.monotonic()
+        self.occupancy: float | None = None  # worker-reported pipeline gauge
+
+
+# a worker silent this long no longer counts toward the auto-tuned
+# min_batch (dead workers must not stall the coalescing window; the
+# supervisor's respawn re-hello refreshes the stamp)
+_LIVE_TTL_S = 30.0
 
 
 class InferenceServer:
@@ -50,6 +77,13 @@ class InferenceServer:
       unroll_length: trajectory chunk length T emitted to ``chunks``.
       min_batch / max_wait_ms: micro-batching knobs — run the forward once
         this many worker requests are pending, or after the wait expires.
+      transport: 'auto' grants shm hellos; 'pickle' denies them (every
+        worker then falls back to the pickle wire).
+      auto_tune: retune ``min_batch`` to the live connected-worker count
+        and ``max_wait_ms`` to a fraction of the serve-latency EWMA —
+        a fleet that shrinks (worker death) or grows (respawn, elastic
+        scaling) keeps coalescing into one forward per lockstep round
+        without the trainer re-plumbing the knobs.
     """
 
     def __init__(
@@ -59,6 +93,8 @@ class InferenceServer:
         min_batch: int = 1,
         max_wait_ms: float = 2.0,
         bind: str = "tcp://127.0.0.1:*",
+        transport: str = "auto",
+        auto_tune: bool = False,
     ):
         self._act_fn = act_fn
         self._act_lock = threading.Lock()
@@ -66,6 +102,10 @@ class InferenceServer:
         self.unroll_length = unroll_length
         self.min_batch = min_batch
         self.max_wait_ms = max_wait_ms
+        if transport not in ("auto", "pickle"):
+            raise ValueError(f"transport {transport!r} not in auto|pickle")
+        self.transport = transport
+        self.auto_tune = bool(auto_tune)
         self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
         # data-plane observability (SURVEY.md §5.5: the reference's
         # tensorplex tracked replay/fetch-queue occupancy): queue-full
@@ -79,6 +119,11 @@ class InferenceServer:
         # server thread; GIL-atomic float reads from the trainer.
         self._serve_ms_ewma: float | None = None
         self._serve_batch_ewma: float | None = None
+        # wire accounting: control/payload bytes in+out and env steps
+        # served — the bytes/step gauge is the zero-copy transport's
+        # success metric (pickle ships the arrays; shm ships ~30 B frames)
+        self._wire_bytes = 0
+        self._served_steps = 0
 
         # rolling completed-episode stats shipped by workers (SURVEY.md
         # §5.5); read via episode_stats(). Window matches the host
@@ -99,7 +144,11 @@ class InferenceServer:
         self._sock.setsockopt(zmq.ROUTER_HANDOVER, 1)
         self._sock.bind(bind)
         self.address = self._sock.getsockopt_string(zmq.LAST_ENDPOINT)
-        self._tracks: dict[bytes, _WorkerTrack] = {}
+        self._tracks: dict[tuple[bytes, int], _WorkerTrack] = {}
+        self._states: dict[bytes, _WorkerState] = {}
+        # preallocated scratch batches keyed by (tail shape, dtype str),
+        # grown geometrically — the per-serve concatenate replacement
+        self._scratch: dict[tuple, np.ndarray] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -136,10 +185,27 @@ class InferenceServer:
                         ident, payload = self._sock.recv_multipart(zmq.NOBLOCK)
                     except zmq.Again:
                         break
-                    msg = pickle.loads(payload)
+                    self._wire_bytes += len(payload)
+                    kind, obj = dp.decode_payload(payload)
+                    if kind == "hello":
+                        self._handle_hello(ident, obj)
+                        continue
+                    if kind == "step":
+                        msg = self._shm_step_to_msg(ident, obj)
+                        if msg is None:
+                            continue  # no negotiated slab for this identity
+                    else:  # 'msg' — the pickle fallback dict
+                        msg = obj
+                        st = self._states.get(ident)
+                        if st is not None:
+                            st.last_seen = time.monotonic()
+                        else:
+                            self._states[ident] = _WorkerState()
                     if not pending:
                         deadline = time.monotonic() + self.max_wait_ms / 1000
                     pending.append((ident, msg))
+            if self.auto_tune:
+                self._retune()
             ready = len(pending) >= self.min_batch or (
                 pending and deadline is not None and time.monotonic() >= deadline
             )
@@ -148,6 +214,134 @@ class InferenceServer:
                 pending = []
                 deadline = None
         self._sock.close(0)
+
+    def _retune(self) -> None:
+        """Coalescing auto-tune: one forward per lockstep fleet round.
+
+        ``min_batch`` tracks the recently-live worker count (each worker
+        keeps ~1 request per sub-slice in flight, so a full round is at
+        least one request per worker); ``max_wait_ms`` scales with the
+        serve-latency EWMA — when a serve costs 40 ms, waiting 10 ms to
+        coalesce stragglers is cheap; when it costs 2 ms, waiting is the
+        bottleneck."""
+        now = time.monotonic()
+        live = sum(
+            1 for st in self._states.values()
+            if now - st.last_seen < _LIVE_TTL_S
+        )
+        self.min_batch = max(1, live)
+        if self._serve_ms_ewma is not None:
+            self.max_wait_ms = min(20.0, max(1.0, 0.25 * self._serve_ms_ewma))
+
+    def _handle_hello(self, ident: bytes, info: dict) -> None:
+        """Negotiate (or re-negotiate) the shm slab for one identity.
+
+        A respawned worker re-hellos under its dead predecessor's identity
+        (ROUTER_HANDOVER): a matching geometry reuses the existing slab; a
+        changed one unlinks and recreates. Either way the SERVER keeps
+        ownership, so a SIGKILLed worker can never leak ``/dev/shm``."""
+        st = self._states.setdefault(ident, _WorkerState())
+        st.last_seen = time.monotonic()
+        if self.transport == "pickle":
+            self._send_to(ident, dp.encode_hello_reply(None, None, "transport=pickle"))
+            return
+        spec = dp.SlabSpec.from_json(info)
+        if st.slab is not None and st.spec is not None and st.spec.matches(spec):
+            self._send_to(ident, dp.encode_hello_reply(st.slab.name, st.spec))
+            return
+        self._release_slab(st)
+        # geometry changed (or first hello): any half-built per-slot
+        # chunks belong to the old geometry — drop them
+        for key in [k for k in self._tracks if k[0] == ident]:
+            del self._tracks[key]
+        try:
+            st.slab = dp.create_slab(spec, tag=ident.decode(errors="replace")[-12:])
+        except OSError as e:
+            self._send_to(ident, dp.encode_hello_reply(None, None, f"create failed: {e}"))
+            return
+        st.spec = spec
+        st.views = spec.views(st.slab.buf)
+        self._send_to(ident, dp.encode_hello_reply(st.slab.name, spec))
+
+    def _shm_step_to_msg(self, ident: bytes, header: dict) -> dict | None:
+        """Materialize one shm STEP frame into the message dict the record
+        path consumes.
+
+        Copy discipline: ``obs`` stays a slab VIEW here — it is consumed
+        synchronously during ``_serve_batch`` (scratch gather / next_obs
+        where / the forward's fast path) BEFORE the reply frame releases
+        the worker to overwrite the slot, and ``_record`` copies it when
+        installing pending state (the one place it outlives the serve).
+        reward/done/truncated are copied now (tiny) because they are
+        stored into trajectory steps as-is; terminal_obs stays a view
+        (consumed by ``np.where`` inside the same serve)."""
+        st = self._states.get(ident)
+        if st is None or st.slab is None:
+            return None  # stale frame from a pre-restart negotiation
+        st.last_seen = time.monotonic()
+        slot = int(header["slot"])
+        if slot >= len(st.views):
+            return None
+        v = st.views[slot]
+        msg: dict = {"obs": v["obs"], "slot": slot, "_shm": True}
+        if header["flags"] & dp.F_HAS_REWARD:
+            msg["reward"] = np.array(v["reward"])
+            msg["done"] = np.array(v["done"])
+            msg["truncated"] = np.array(v["truncated"])
+            if header["flags"] & dp.F_HAS_TERMINAL:
+                msg["terminal_obs"] = v["terminal_obs"]
+        if header["flags"] & dp.F_FINAL:
+            msg["final"] = True
+        if header["flags"] & dp.F_HAS_GAUGES:
+            msg["act_latency_ms"] = header["act_latency_ms"]
+            st.occupancy = float(header["pipeline_occupancy"])
+        if header["episode_returns"]:
+            msg["episode_returns"] = header["episode_returns"]
+            msg["episode_lengths"] = header["episode_lengths"]
+        return msg
+
+    def _send_to(self, ident: bytes, payload: bytes) -> None:
+        self._wire_bytes += len(payload)
+        self._sock.send_multipart([ident, payload])
+
+    def _reply(self, ident: bytes, msg: dict, actions: np.ndarray) -> None:
+        """Route one action slice back: written straight into the worker's
+        action slab (a control frame signals readiness) under shm, pickled
+        under the fallback — decided per REQUEST, so a worker that fell
+        back mid-negotiation still gets replies it can decode."""
+        slot = int(msg.get("slot", 0))
+        if msg.get("_shm"):
+            st = self._states[ident]
+            np.copyto(st.views[slot]["action"], actions, casting="same_kind")
+            self._send_to(ident, dp.encode_step_reply(slot))
+        else:
+            self._send_to(ident, dp.encode_pickle_reply(slot, actions))
+
+    def _gather(self, requests: list[tuple[bytes, dict]]) -> np.ndarray:
+        """Assemble the micro-batch into the preallocated scratch buffer
+        (slab/array slices copied in place — no per-serve concatenate).
+        The scratch is reused across serves; every consumer (the forward,
+        record-path copies) runs before the next serve touches it."""
+        first = requests[0][1]["obs"]
+        tail, dtype = first.shape[1:], first.dtype
+        n = sum(r[1]["obs"].shape[0] for r in requests)
+        if any(
+            r[1]["obs"].shape[1:] != tail or r[1]["obs"].dtype != dtype
+            for r in requests
+        ):  # heterogeneous fleet — correctness fallback, not the steady state
+            return np.concatenate([r[1]["obs"] for r in requests], axis=0)
+        key = (tail, dtype.str)
+        buf = self._scratch.get(key)
+        if buf is None or buf.shape[0] < n:
+            cap = 1 << max(n - 1, 1).bit_length()
+            buf = np.empty((cap, *tail), dtype)
+            self._scratch[key] = buf
+        off = 0
+        for _, msg in requests:
+            o = msg["obs"]
+            buf[off : off + o.shape[0]] = o
+            off += o.shape[0]
+        return buf[:n]
 
     def _serve_batch(self, requests: list[tuple[bytes, dict]]) -> None:
         # 'final' flushes come from exiting workers: stitch the transition
@@ -162,13 +356,14 @@ class InferenceServer:
         t0 = time.monotonic()
         if len(requests) == 1:
             # fast path (the steady state at min_batch=1): a lone pending
-            # request needs no concatenate into a scratch batch and no
-            # re-slice back out — act on the worker's array directly and
-            # ship the results whole. Record-identical to the batched
-            # path below (slice 0:n of a 1-request batch IS the batch).
+            # request needs no gather into the scratch batch and no
+            # re-slice back out — act on the worker's array directly
+            # (still pre-reply, so a slab view is safe) and ship the
+            # results whole. Record-identical to the batched path below
+            # (slice 0:n of a 1-request batch IS the batch).
             obs = requests[0][1]["obs"]
         else:
-            obs = np.concatenate([r[1]["obs"] for r in requests], axis=0)
+            obs = self._gather(requests)
         with self._act_lock:
             actions, info = self._act_fn(obs)
             info = dict(info, param_version=np.full(len(obs), self._version, np.int32))
@@ -177,7 +372,7 @@ class InferenceServer:
         if len(requests) == 1:
             ident, msg = requests[0]
             self._record(ident, msg, actions, info)
-            self._sock.send_multipart([ident, pickle.dumps(actions, protocol=5)])
+            self._reply(ident, msg, actions)
         else:
             offset = 0
             for ident, msg in requests:
@@ -185,7 +380,8 @@ class InferenceServer:
                 sl = slice(offset, offset + n)
                 offset += n
                 self._record(ident, msg, actions[sl], {k: v[sl] for k, v in info.items()})
-                self._sock.send_multipart([ident, pickle.dumps(actions[sl], protocol=5)])
+                self._reply(ident, msg, actions[sl])
+        self._served_steps += len(obs)
         ms = (time.monotonic() - t0) * 1e3
         self._serve_ms_ewma = (
             ms if self._serve_ms_ewma is None
@@ -217,9 +413,11 @@ class InferenceServer:
         if "act_latency_ms" in msg:
             with self._ep_lock:
                 self._act_latencies.append(float(msg["act_latency_ms"]))
-        track = self._tracks.setdefault(ident, _WorkerTrack())
+        track = self._tracks.setdefault(
+            (ident, int(msg.get("slot", 0))), _WorkerTrack()
+        )
         if "reward" not in msg and track.steps:
-            # obs-only hello on an identity that already has partial steps:
+            # obs-only hello on a slot that already has partial steps:
             # a respawned worker replacing a dead one. Its fresh episode
             # must not be spliced onto the dead worker's half-built chunk
             # (no done boundary would separate them, and GAE/V-trace would
@@ -255,8 +453,12 @@ class InferenceServer:
         if final:
             track.pending = None  # worker is exiting; nothing more will come
         else:
+            # np.array (unconditional copy), not asarray: under shm,
+            # msg['obs'] is a slab view the worker overwrites as soon as
+            # the reply lands — pending outlives the serve, so it must own
+            # its memory (the pickle path pays one redundant small copy)
             track.pending = {
-                "obs": np.asarray(msg["obs"]), "action": actions, "info": info
+                "obs": np.array(msg["obs"]), "action": actions, "info": info
             }
         if len(track.steps) >= self.unroll_length:
             chunk = {
@@ -289,10 +491,29 @@ class InferenceServer:
                     except queue.Empty:
                         pass
 
+    def transport_stats(self) -> dict[str, float]:
+        """Negotiated-transport mix + the zero-copy success metrics:
+        wire bytes per served env step and the fleet pipeline-occupancy
+        gauge (fraction of worker wall time spent stepping envs rather
+        than waiting on replies). Server-thread-written, GIL-atomic reads."""
+        states = list(self._states.values())  # snapshot: trainer-thread
+        # reads race the server thread's hello-time inserts
+        shm = sum(1 for st in states if st.slab is not None)
+        occ = [st.occupancy for st in states if st.occupancy is not None]
+        out = {
+            "shm_workers": float(shm),
+            "pickle_workers": float(len(states) - shm),
+            "wire_bytes_per_step": self._wire_bytes / max(self._served_steps, 1),
+        }
+        if occ:
+            out["pipeline_occupancy"] = sum(occ) / len(occ)
+        return out
+
     def queue_stats(self) -> dict[str, float]:
-        """Chunk-queue occupancy, eviction counts, and serve/act latency
-        for the metrics stream (the tensorplex fetch-queue-occupancy role,
-        plus the telemetry spine's latency side-band)."""
+        """Chunk-queue occupancy, eviction counts, serve/act latency, and
+        the data-plane transport gauges for the metrics stream (the
+        tensorplex fetch-queue-occupancy role, plus the telemetry spine's
+        latency side-band)."""
         out = {
             "server/queue_depth": float(self.chunks.qsize()),
             "server/evicted_chunks": float(self.evicted_chunks),
@@ -304,6 +525,9 @@ class InferenceServer:
             out["server/serve_ms"] = float(self._serve_ms_ewma)
         if self._serve_batch_ewma is not None:
             out["server/serve_batch"] = float(self._serve_batch_ewma)
+        out.update(
+            {f"server/{k}": v for k, v in self.transport_stats().items()}
+        )
         with self._ep_lock:
             if self._act_latencies:
                 out["server/act_latency_ms"] = sum(self._act_latencies) / len(
@@ -311,6 +535,37 @@ class InferenceServer:
                 )
         return out
 
+    def _release_slab(self, st: _WorkerState) -> None:
+        if st.slab is not None:
+            try:
+                st.slab.close()
+                st.slab.unlink()
+            except OSError:  # pragma: no cover - already gone
+                pass
+            st.slab = None
+            st.spec = None
+            st.views = []
+
+    def _release_all_after_join(self) -> None:
+        self._thread.join()
+        for st in self._states.values():
+            self._release_slab(st)
+
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=2)
+        # unlink every server-owned segment AFTER the serve thread is down
+        # (it holds live views); this is the no-/dev/shm-leak guarantee,
+        # including for slabs whose workers were SIGKILLed mid-run
+        if self._thread.is_alive():
+            # serve thread wedged mid-serve (the first act_fn can sit in
+            # an XLA compile for minutes): releasing now would unmap
+            # views it still dereferences — SIGSEGV instead of shutdown.
+            # Defer to a daemon that waits it out; if the process exits
+            # first, the creator-side resource tracker still unlinks.
+            threading.Thread(
+                target=self._release_all_after_join, daemon=True
+            ).start()
+            return
+        for st in self._states.values():
+            self._release_slab(st)

@@ -118,6 +118,17 @@ class ParameterServer:
                         self._rep.send_multipart(
                             [ver.to_bytes(8, "little"), b""]
                         )
+                    elif (
+                        req.startswith(b"fetch?")
+                        and len(req) == 14
+                        and int.from_bytes(req[6:], "little") == latest[0]
+                    ):
+                        # version-conditional fetch: the client already
+                        # holds this snapshot — skip the blob transfer AND
+                        # the client-side decompress/deserialize (steady-
+                        # state pollers between publishes pay ~14 bytes
+                        # each way instead of the full pytree)
+                        self._rep.send_multipart([b"unchanged", b""])
                     else:  # any other payload = "give me latest"
                         ver, blob = latest
                         self._rep.send_multipart(
@@ -197,12 +208,19 @@ class ParameterClient:
         return self._req.recv_multipart()
 
     def fetch(self, timeout_ms: int = 5000) -> Any | None:
-        """Returns the latest params pytree, or None if nothing published
-        yet. Raises TimeoutError on a silent server — after RECOVERING the
-        REQ socket (a strict REQ with an outstanding send would fail every
-        later fetch with EFSM), so callers may simply retry."""
-        ver, blob = self._request(b"fetch", timeout_ms)
-        if ver == b"none":
+        """Returns the latest params pytree, or None when there is nothing
+        NEW for this client — nothing published yet, or the server's
+        version equals the last one fetched (the request carries
+        ``self.version``, so an unchanged server answers ``b"unchanged"``
+        without shipping or re-decompressing the blob; callers keep their
+        current params either way). Raises TimeoutError on a silent
+        server — after RECOVERING the REQ socket (a strict REQ with an
+        outstanding send would fail every later fetch with EFSM), so
+        callers may simply retry."""
+        ver, blob = self._request(
+            b"fetch?" + self.version.to_bytes(8, "little"), timeout_ms
+        )
+        if ver in (b"none", b"unchanged"):
             return None
         self.version = int.from_bytes(ver, "little")
         return loads_pytree(self.template, blob)

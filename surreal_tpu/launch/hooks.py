@@ -177,6 +177,18 @@ class SessionHooks:
         """Latest synced train metrics merged with latest eval metrics."""
         return {**self._last_train, **self._last_eval}
 
+    def data_plane_event(self, **info) -> None:
+        """Record the SEED data plane's negotiated shape (transport mix,
+        pipeline occupancy, wire bytes/step) as one log line + one
+        telemetry ``data_plane`` event — `surreal_tpu diag` surfaces the
+        last one, so a session folder answers "did shm actually engage?"
+        without grepping metrics rows."""
+        self.log.info(
+            "data plane: %s",
+            " ".join(f"{k}={v}" for k, v in sorted(info.items())),
+        )
+        self.tracer.event("data_plane", **info)
+
     def final_metrics(self, env_steps: int, extras=None) -> None:
         """Refresh the trailing metrics snapshot at run end. Drivers whose
         loop can consume env-step budget WITHOUT a metrics-cadence fire

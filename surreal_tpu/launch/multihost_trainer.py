@@ -533,6 +533,12 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                 "a single-host SEED feature"
             )
         SEEDTrainer.__init__(self, config)
+        # pipelined sub-slices would halve the per-rank chunk width, and
+        # the collective learn schedule is built on [horizon, num_envs]
+        # chunks (one per rank, global width num_envs * nprocs checked
+        # against dp below) — keep the documented width; round-trip
+        # hiding matters least here since every rank acts host-locally
+        self.pipeline_workers = False
         if self.max_staleness is not None:
             raise ValueError(
                 "max_staleness is single-host SEED only: dropping a chunk "

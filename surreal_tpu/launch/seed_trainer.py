@@ -156,8 +156,35 @@ class SEEDTrainer:
                 "`eval --follow`."
             )
         self.algo = self.learner.config.algo
-        self.num_workers = max(1, config.session_config.topology.num_env_workers)
+        topo = config.session_config.topology
+        self.num_workers = max(1, topo.num_env_workers)
         self.worker_mode = worker_mode
+        # host data plane (distributed/shm_transport.py). `.get` keeps
+        # configs saved before the knobs existed loadable. 'auto' resolves
+        # to pickle for thread workers (in-process tests keep the original
+        # wire) and to shm negotiation for process workers, which are
+        # always spawned on this host.
+        self.transport = topo.get("transport", "auto")
+        if self.transport not in ("auto", "shm", "pickle"):
+            raise ValueError(
+                f"topology.transport {self.transport!r} not in auto|shm|pickle"
+            )
+        self.worker_transport = (
+            "pickle"
+            if self.transport == "auto" and worker_mode == "thread"
+            else self.transport
+        )
+        self.worker_silence_s = float(topo.get("worker_silence_s", 120.0))
+        n_envs = int(config.env_config.num_envs)
+        # pipelined sub-slices halve the per-chunk batch width, so the
+        # learn program compiles once per width: keep widths uniform (even
+        # split only) and dp-divisible
+        self.pipeline_workers = bool(topo.get("pipeline_workers", True)) and (
+            n_envs >= 2 and n_envs % 2 == 0
+        )
+        dp_axis = int(topo.mesh.dp)
+        if self.pipeline_workers and dp_axis > 1 and (n_envs // 2) % dp_axis:
+            self.pipeline_workers = False
         if max_staleness is _FROM_CONFIG:
             # read the EXTENDED algo tree (build_learner layered per-algo +
             # base defaults onto it), not the raw user overrides
@@ -211,6 +238,11 @@ class SEEDTrainer:
         have started threads is unsafe, and workers only need numpy + the
         host env anyway.
         """
+        kwargs = dict(
+            transport=self.worker_transport,
+            pipeline=self.pipeline_workers,
+            server_silence_s=self.worker_silence_s,
+        )
         if self.worker_mode == "process":
             import multiprocessing as mp
 
@@ -218,13 +250,14 @@ class SEEDTrainer:
             w = ctx.Process(
                 target=run_env_worker,
                 args=(env_cfg.to_dict(), address, i),
+                kwargs=kwargs,
                 daemon=True,
             )
         else:
             w = threading.Thread(
                 target=run_env_worker,
                 args=(env_cfg, address, i),
-                kwargs={"stop_event": stop},
+                kwargs=dict(kwargs, stop_event=stop),
                 daemon=True,
             )
         w.start()
@@ -263,9 +296,14 @@ class SEEDTrainer:
             unroll_length=self.algo.horizon,
             # coalesce all workers into one forward per lockstep round:
             # with min_batch=1 a W-worker fleet degrades to ~W serves
-            # per round, and serve latency (not compute) is the bound
+            # per round, and serve latency (not compute) is the bound.
+            # auto_tune keeps this true as the fleet shrinks/regrows
+            # (worker death, respawn) and scales the coalescing wait to
+            # the serve-latency EWMA.
             min_batch=self.num_workers,
             max_wait_ms=5.0,
+            transport="pickle" if self.worker_transport == "pickle" else "auto",
+            auto_tune=True,
         )
         try:
             env_cfg = self._worker_env_config(
@@ -373,6 +411,7 @@ class SEEDTrainer:
 
             dropped_stale = 0
             discarded_steps = 0
+            dp_event_emitted = False
 
             def data_plane_extras() -> dict:
                 """One source of truth for the drop/eviction/episode
@@ -419,6 +458,16 @@ class SEEDTrainer:
                 iteration += 1
                 env_steps += n_steps
                 plane.supervise()
+                if not dp_event_emitted:
+                    # negotiated data-plane shape, once the fleet settled
+                    # (visible in `surreal_tpu diag` without a metrics row)
+                    hooks.data_plane_event(
+                        transport=self.worker_transport,
+                        pipeline=self.pipeline_workers,
+                        workers=self.num_workers,
+                        **server.transport_stats(),
+                    )
+                    dp_event_emitted = True
                 metrics = dict(
                     metrics,
                     **{"staleness/updates_behind": float(staleness)},
@@ -435,6 +484,14 @@ class SEEDTrainer:
             # duplicate the final writer row at every_n_iters=1)
             if hooks.last_metrics.get("time/env_steps") != env_steps:
                 hooks.final_metrics(env_steps, data_plane_extras())
+            if dp_event_emitted:
+                # settled end-of-run gauges (bytes/step over the whole run)
+                hooks.data_plane_event(
+                    transport=self.worker_transport,
+                    pipeline=self.pipeline_workers,
+                    workers=self.num_workers,
+                    **server.transport_stats(),
+                )
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:

@@ -108,6 +108,23 @@ BASE_SESSION_CONFIG = Config(
         # spawn ctx — MuJoCo-heavy stepping holds the GIL, so real
         # deployments fork like the reference's actor pool did)
         worker_mode="thread",
+        # SEED host data plane (distributed/shm_transport.py):
+        # - transport: 'auto' negotiates per-worker zero-copy shared-memory
+        #   slabs for process workers against the local server (pickle for
+        #   thread mode and remote workers); 'shm' forces the slab grant;
+        #   'pickle' keeps the original serialized wire everywhere.
+        # - pipeline_workers: each worker splits its env slice into two
+        #   sub-slices and steps one while the other's actions are in
+        #   flight (double-buffered acting, Stooke & Abbeel 1803.02811) —
+        #   hides the server round trip; needs an even num_envs (auto-
+        #   disabled otherwise, and under a dp mesh whose width the
+        #   sub-slice would not divide).
+        # - worker_silence_s: per-step server-liveness budget in the
+        #   worker (was hard-coded 120 s; the first replies legitimately
+        #   wait out XLA compiles on a tunneled TPU).
+        transport="auto",
+        pipeline_workers=True,
+        worker_silence_s=120.0,
         # host-env (gym/dm_control) loops: collect iteration k+1 on a
         # worker thread while the device learns on k (the reference's
         # learner never waited on actors — its prefetch thread kept

@@ -37,6 +37,10 @@ line, ``t`` = unix seconds):
     {"type": "compile_cache", "t": ..., "dir": "...", "hits": H,
      "misses": M}   (cumulative; written by SessionHooks when
                      session.compile_cache_dir is active)
+    {"type": "data_plane", "t": ..., "transport": "...", "pipeline": ...,
+     "shm_workers": N, "pickle_workers": M, "wire_bytes_per_step": B,
+     ...}           (SEED drivers via SessionHooks.data_plane_event; the
+                     last event reflects the settled negotiation)
 
 Heartbeats live per rank in ``telemetry/heartbeat_rank<k>.jsonl``:
 
@@ -265,6 +269,7 @@ def diag_summary(folder: str) -> dict | None:
     phases: dict[str, dict] = {}
     health: dict[str, dict] = {}
     compile_cache = None
+    data_plane = None
     nonfinite_windows = 0
     t_first = t_last = None
     last_step = None
@@ -290,6 +295,12 @@ def diag_summary(folder: str) -> dict | None:
                 "dir": ev.get("dir"),
                 "hits": int(ev.get("hits", 0)),
                 "misses": int(ev.get("misses", 0)),
+            }
+        elif ev.get("type") == "data_plane":
+            # the last event is the settled negotiation (SEED drivers emit
+            # one after the first learn and one at run end)
+            data_plane = {
+                k: v for k, v in ev.items() if k not in ("type", "t")
             }
         elif ev.get("type") == "metrics":
             last_step = ev.get("step", last_step)
@@ -328,6 +339,7 @@ def diag_summary(folder: str) -> dict | None:
         "phases": phases,
         "health": health,
         "compile_cache": compile_cache,
+        "data_plane": data_plane,
         "nonfinite_windows": nonfinite_windows,
         "heartbeats": heartbeats,
     }
@@ -378,6 +390,13 @@ def diag_report(folder: str) -> str | None:
                 f" ({100.0 * cc['hits'] / total:.0f}% warm)"
                 if total else ""
             ),
+        ]
+    dpl = s.get("data_plane")
+    if dpl is not None:
+        lines += [
+            "",
+            "Data plane — "
+            + ", ".join(f"{k}={dpl[k]}" for k in sorted(dpl)),
         ]
     lines += ["", "Training health"]
     if s["health"]:

@@ -68,6 +68,43 @@ def test_param_publisher_server_client_roundtrip():
         pub.close()
 
 
+def test_param_client_fetch_is_version_conditional():
+    """The fetch carries the client's last-seen version; an unchanged
+    server answers ``b"unchanged"`` (14 bytes) instead of shipping and
+    re-decompressing the whole pytree — steady-state pollers between
+    publishes pay control bytes only."""
+    pub = ParameterPublisher()
+    server = ParameterServer(pub.address)
+    client = ParameterClient(server.address, template={"w": jnp.zeros(3)})
+    fresh = ParameterClient(server.address, template={"w": jnp.zeros(3)})
+    try:
+        pub.publish({"w": jnp.full((3,), 4.0)})
+        deadline = time.time() + 5
+        got = None
+        while got is None and time.time() < deadline:
+            got = client.fetch()
+        np.testing.assert_allclose(np.asarray(got["w"]), 4.0)
+        assert client.version == 1
+        # nothing new published: the conditional fetch returns None and
+        # must NOT regress the client's version
+        assert client.fetch() is None
+        assert client.version == 1
+        # a client that has never fetched still gets the full blob
+        got2 = fresh.fetch()
+        np.testing.assert_allclose(np.asarray(got2["w"]), 4.0)
+        # a new publish makes the conditional fetch full again
+        pub.publish({"w": jnp.full((3,), 5.0)})
+        time.sleep(0.2)
+        got3 = client.fetch()
+        np.testing.assert_allclose(np.asarray(got3["w"]), 5.0)
+        assert client.version == 2
+    finally:
+        client.close()
+        fresh.close()
+        server.close()
+        pub.close()
+
+
 def test_param_server_multi_bind_serves_every_endpoint():
     """One REP socket bound to several endpoints serves clients on each
     (the multi-bind sharding axis the reference's ShardedParameterServer
@@ -297,11 +334,15 @@ def test_inference_server_single_request_fast_path_matches_batched():
     ])
 
     # wire replies identical per worker (order differs: singles serve w1
-    # then w2; the batch interleaves — compare as ident-keyed dicts)
+    # then w2; the batch interleaves — compare as ident-keyed dicts).
+    # Fallback-transport replies are slot-tagged (slot, actions) tuples
+    # since the shm/pipelining PR; these unsliced workers are all slot 0.
     def replies(server):
         out = {}
         for i, (ident, payload) in enumerate(server._sock.sent):
-            out.setdefault(ident, []).append(pickle.loads(payload))
+            slot, actions = pickle.loads(payload)
+            assert slot == 0
+            out.setdefault(ident, []).append(actions)
         return out
 
     rs, rb = replies(single), replies(batched)
@@ -429,7 +470,9 @@ def test_seed_trainer_max_staleness_drops_old_chunks():
     # Workers outpace the learner during its first XLA compile, so queue-
     # full evictions DO happen here and must be visible in metrics.
     assert "server/queue_depth" in metrics
-    chunk_steps = 4 * 2  # horizon x num_envs
+    # horizon x per-chunk width: pipelined workers (the default) split
+    # num_envs into two sub-slices, each its own trajectory stream
+    chunk_steps = 4 * (2 // 2)
     assert (
         metrics["server/evicted_steps"]
         == metrics["server/evicted_chunks"] * chunk_steps

@@ -165,6 +165,41 @@ def test_jitted_steps_declare_donation():
     )
 
 
+_DATA_PLANE_STEADY_STATE = (
+    # the steady-state serve/step loop modules: one pickle of an ndarray
+    # payload per env step is exactly the cost the zero-copy transport
+    # removed, and the easiest regression to reintroduce
+    "distributed/env_worker.py",
+    "distributed/inference_server.py",
+    "launch/seed_trainer.py",
+)
+
+
+def test_data_plane_pickles_only_in_fallback_codec():
+    """Data-plane serialization lint (the shm-transport PR's invariant):
+    ``pickle.dumps``/``pickle.loads`` of ndarray payloads may appear only
+    in the fallback transport module and control-frame codec
+    (``distributed/shm_transport.py``) — never in the steady-state
+    serve/step loops, which must route every encode/decode through the
+    codec so the transport decision stays in one place."""
+    banned = ("pickle.dumps(", "pickle.loads(", "import pickle")
+    bad = []
+    for rel in _DATA_PLANE_STEADY_STATE:
+        src = (_PKG_ROOT / rel).read_text()
+        for b in banned:
+            if b in src:
+                bad.append(f"{rel}: {b}")
+    assert not bad, (
+        "ndarray pickling belongs to distributed/shm_transport.py (the "
+        "fallback codec), not the steady-state data-plane loops:\n"
+        + "\n".join(bad)
+    )
+    codec = (_PKG_ROOT / "distributed/shm_transport.py").read_text()
+    assert "pickle.dumps(" in codec and "pickle.loads(" in codec, (
+        "the fallback codec moved out of shm_transport.py; update this lint"
+    )
+
+
 def test_graft_entry_import_initializes_no_backend():
     """__graft_entry__ itself must also be import-clean: the driver imports
     it before calling dryrun_multichip, which is where platform selection
