@@ -54,6 +54,11 @@ HORIZON = 256
 WARMUP_ITERS = 2
 MEASURE_ITERS = 10
 NORTH_STAR = 100_000.0
+# program autotuner arm (surreal_tpu/tune/): --autotune cache|search and
+# --tuning-cache DIR select it; the artifact ALWAYS records the active
+# decision so a record can't silently mix tuned and untuned arms
+AUTOTUNE = "off"
+TUNING_CACHE_DIR = None
 # TPU v5e (v5lite) public peak: 197 TFLOP/s bf16 per chip — the MFU
 # denominator. This workload is latency-bound on the env scan, so MFU is
 # an honesty metric (expectedly tiny), not a target.
@@ -80,11 +85,13 @@ def _measure() -> dict:
 
     cfg = Config(
         learner_config=Config(
-            algo=Config(name="ppo", horizon=HORIZON, epochs=4, num_minibatches=4),
+            algo=Config(name="ppo", horizon=HORIZON, epochs=4,
+                        num_minibatches=4, autotune=AUTOTUNE),
         ),
         env_config=Config(name="jax:lift", num_envs=NUM_ENVS),
         session_config=Config(
             folder="/tmp/bench_lift",
+            tuning_cache_dir=TUNING_CACHE_DIR,
             metrics=Config(every_n_iters=10_000),  # no host syncs mid-bench
             checkpoint=Config(every_n_iters=0),
             eval=Config(every_n_iters=0),
@@ -134,6 +141,10 @@ def _measure() -> dict:
         # must never masquerade as the per-chip record
         "device": str(jax.devices()[0].device_kind),
         "platform": str(jax.devices()[0].platform),
+        # the active autotuner decision (mode, cache hit/miss, applied
+        # config): a bench record must never silently mix tuned and
+        # untuned arms (surreal_tpu/tune/)
+        "tuning": trainer.tune_decision.artifact(),
     }
     if flops_per_iter is not None:
         achieved = flops_per_iter * MEASURE_ITERS / dt
@@ -195,6 +206,15 @@ def main() -> int:
         from perf_wallclock import host_path_main
 
         return host_path_main(sys.argv[1:])
+    global AUTOTUNE, TUNING_CACHE_DIR
+    if "--autotune" in sys.argv:
+        AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]
+    if "--tuning-cache" in sys.argv:
+        import os
+
+        TUNING_CACHE_DIR = os.path.abspath(
+            sys.argv[sys.argv.index("--tuning-cache") + 1]
+        )
     err = None
     for attempt in range(RETRY_ATTEMPTS):
         try:

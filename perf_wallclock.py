@@ -46,6 +46,9 @@ import time
 import jax
 
 COMPILE_CACHE_DIR = None  # set by --compile-cache; threaded into configs
+AUTOTUNE = "off"          # set by --autotune (off|cache|search); every row
+TUNING_CACHE_DIR = None   # records the ACTIVE tuner decision regardless, so
+                          # artifacts can't silently mix tuned/untuned arms
 
 
 def run_to_target(trainer_factory, target: float, seeds, max_minutes=12.0):
@@ -84,6 +87,11 @@ def run_to_target(trainer_factory, target: float, seeds, max_minutes=12.0):
             "compile_cache": dict(
                 compile_cache_counts(), dir=COMPILE_CACHE_DIR
             ) if COMPILE_CACHE_DIR else None,
+            # the active autotuner decision (surreal_tpu/tune/): mode,
+            # cache hit/miss, applied config — tuned and untuned runs
+            # must be distinguishable in the artifact
+            "tuning": trainer.tune_decision.artifact()
+            if hasattr(trainer, "tune_decision") else None,
         }
         out.append(row)
         print(json.dumps(row, default=float), flush=True)
@@ -97,12 +105,14 @@ def lift_trainer(seed: int):
 
     cfg = Config(
         learner_config=Config(
-            algo=Config(name="ppo", horizon=128, epochs=4, num_minibatches=4),
+            algo=Config(name="ppo", horizon=128, epochs=4, num_minibatches=4,
+                        autotune=AUTOTUNE),
         ),
         env_config=Config(name="jax:lift", num_envs=2048),
         session_config=Config(
             folder=f"/tmp/wallclock_lift_{seed}",
             compile_cache_dir=COMPILE_CACHE_DIR,
+            tuning_cache_dir=TUNING_CACHE_DIR,
             seed=seed,
             total_env_steps=10**12,
             # metrics cadence matters on the tunneled chip: every_n_iters=1
@@ -124,13 +134,14 @@ def pong_trainer(seed: int):
 
     cfg = Config(
         learner_config=Config(
-            algo=Config(name="impala", horizon=32),
+            algo=Config(name="impala", horizon=32, autotune=AUTOTUNE),
             model=Config(cnn=Config(enabled=True)),
         ),
         env_config=Config(name="jax:pong", num_envs=1024),
         session_config=Config(
             folder=f"/tmp/wallclock_pong_{seed}",
             compile_cache_dir=COMPILE_CACHE_DIR,
+            tuning_cache_dir=TUNING_CACHE_DIR,
             seed=seed,
             total_env_steps=10**12,
             # every 10, matching the round-4 pong run (see lift note)
@@ -207,6 +218,8 @@ def _host_path_measure(transport: str) -> dict:
         "env_steps_per_s": (s1 - s0) / (t1 - t0),
         "iter_ms": (t1 - t0) / n * 1e3,
         "pipeline_workers": trainer.pipeline_workers,
+        # active autotuner decision ('off' here unless the config opts in)
+        "tuning": trainer.tune_decision.artifact(),
         # negotiated reality, from the server gauges riding the metrics
         "transport": {
             k.split("/", 1)[1]: v
@@ -300,7 +313,13 @@ def main(argv=None) -> None:
     out_path = "WALLCLOCK_r05.json"
     if "--out" in argv:
         out_path = argv[argv.index("--out") + 1]
-    global COMPILE_CACHE_DIR
+    global COMPILE_CACHE_DIR, AUTOTUNE, TUNING_CACHE_DIR
+    if "--autotune" in argv:
+        AUTOTUNE = argv[argv.index("--autotune") + 1]
+    if "--tuning-cache" in argv:
+        TUNING_CACHE_DIR = os.path.abspath(
+            argv[argv.index("--tuning-cache") + 1]
+        )
     cache_was_cold = None
     if "--compile-cache" in argv:
         COMPILE_CACHE_DIR = os.path.abspath(
@@ -317,6 +336,8 @@ def main(argv=None) -> None:
         "device": str(jax.devices()[0].device_kind),
         "compile_cache_dir": COMPILE_CACHE_DIR,
         "compile_cache_was_cold": cache_was_cold,
+        "autotune": AUTOTUNE,
+        "tuning_cache_dir": TUNING_CACHE_DIR,
         "lift_to_1000": run_to_target(lift_trainer, 1000.0, seeds),
         "pong_to_plus5": run_to_target(pong_trainer, 5.0, seeds),
     }

@@ -72,8 +72,22 @@ class OffPolicyTrainer:
         self.config = config
         self.env = make_env(training_env_config(config.env_config))
         self.learner = build_learner(config.learner_config, self.env.specs)
+        # program autotuner: same build-time cache consult as Trainer's
+        # (launch/trainer.py) — applied knobs rewrite the learner overrides
+        from surreal_tpu.tune import resolve_autotune
+
+        self.tune_decision = resolve_autotune(config, self.learner.config)
+        if self.tune_decision.applied:
+            self.learner = build_learner(config.learner_config, self.env.specs)
         algo = self.learner.config.algo
         self.algo = algo
+        # searched scan unrolls (tune/space.py); `.get` keeps configs saved
+        # before the knobs existed loadable
+        self._rollout_unroll = int(algo.get("rollout_unroll", 1))
+        self._update_unroll = max(
+            1, min(int(algo.get("update_unroll", 1)),
+                   int(algo.get("updates_per_iter", 1))),
+        )
         self.horizon = algo.horizon
         self.num_envs = config.env_config.num_envs
         self.device_mode = is_jax_env(self.env)
@@ -135,6 +149,14 @@ class OffPolicyTrainer:
                 self._update_prio = jax.jit(
                     self.replay.update_priorities, donate_argnums=(0,)
                 )
+        # uniform-replay fast path (see run_updates in _device_train_iter):
+        # one batched index draw + gather for the whole update loop.
+        # hasattr gates replay kinds without a batched sampler (fifo).
+        self._batched_sampling = (
+            not self.prioritized
+            and bool(algo.get("batched_uniform_sampling", True))
+            and hasattr(self.replay, "sample_many")
+        )
 
     # -- device (fused) path -------------------------------------------------
     def _init_carry(self, env_key: jax.Array) -> OffPolicyCarry:
@@ -168,6 +190,37 @@ class OffPolicyTrainer:
             ep_length=jnp.zeros(self.num_envs, jnp.int32),
             tail=tail,
         )
+
+    def init_loop_state(self, env_key: jax.Array):
+        """(carry, replay_state) committed to the active mesh — ONE
+        constructor for run(), the autotuner's measurement harness
+        (tune/search.py), and tests, so none of them can drift from the
+        dp path's sharding/donation contract."""
+        carry = self._init_carry(env_key)
+        if self.mesh is not None and self.mesh.size > 1:
+            # commit the carry with the shard_map's own specs at init
+            # (same reason as Trainer.run: an uncommitted carry breaks
+            # the first iteration's donation and pays a reshard)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from surreal_tpu.parallel.dp import offpolicy_carry_specs
+
+            carry = jax.device_put(
+                carry,
+                jax.tree.map(
+                    lambda spec: NamedSharding(self.mesh, spec),
+                    offpolicy_carry_specs(carry),
+                    is_leaf=lambda x: isinstance(x, P),
+                ),
+            )
+        example = self._replay_example()
+        if self.mesh is not None and self.mesh.size > 1:
+            from surreal_tpu.replay.sharded import sharded_replay_init
+
+            replay_state = sharded_replay_init(self.replay, example, self.mesh)
+        else:
+            replay_state = self.replay.init(example)
+        return carry, replay_state
 
     def _replay_example(self) -> dict:
         """Single-transition example pytree sizing the replay storage."""
@@ -226,7 +279,11 @@ class OffPolicyTrainer:
             return new_c, trans
 
         keys = jax.random.split(key, self.horizon)
-        return jax.lax.scan(step, carry, keys)
+        # searched rollout-scan unroll (algo.rollout_unroll, tune/space.py)
+        return jax.lax.scan(
+            step, carry, keys,
+            unroll=max(1, min(self._rollout_unroll, self.horizon)),
+        )
 
     def _device_train_iter(
         self, state, replay_state, carry, key, beta, warmup, first, axis_name=None
@@ -264,6 +321,42 @@ class OffPolicyTrainer:
 
         def run_updates(operand):
             state, replay_state = operand
+            ukeys = jax.random.split(ukey, self.algo.updates_per_iter)
+
+            if self._batched_sampling:
+                # uniform-replay fast path: ALL updates_per_iter index
+                # sets drawn in one batched randint + ONE ring gather,
+                # instead of a full-buffer gather inside every scan step
+                # (64 sequential draws at the DDPG default). Record-
+                # equivalent by construction: sample_many derives set k
+                # from ukeys[k] exactly as sample() would, and learn
+                # consumes the same ukeys[k] — tests/test_replay.py pins
+                # bit-equal indices/batches, tests/test_tune.py pins the
+                # fused iteration against the sequential path. Prioritized
+                # replay keeps the sequential path: priorities change
+                # between updates, so later draws depend on earlier TDs.
+                replay_state, batches, idx = self.replay.sample_many(
+                    replay_state, ukeys
+                )
+
+                def one_update_batched(state, xs):
+                    batch, update_key, idx_k = xs
+                    state, metrics = self.learner.learn(
+                        state, batch, update_key, axis_name
+                    )
+                    # same staleness gauge as the sequential path below
+                    age = self.replay.age_frac(replay_state, idx_k)
+                    if axis_name is not None:
+                        age = jax.lax.pmean(age, axis_name)
+                    metrics["replay/sample_age_frac"] = age
+                    metrics.pop("priority/td_abs")
+                    return state, metrics
+
+                state, metrics = jax.lax.scan(
+                    one_update_batched, state, (batches, ukeys, idx),
+                    unroll=self._update_unroll,
+                )
+                return state, replay_state, jax.tree.map(jnp.mean, metrics)
 
             def one_update(c, update_key):
                 state, replay_state = c
@@ -294,10 +387,12 @@ class OffPolicyTrainer:
                     )
                 return (state, replay_state), metrics
 
+            # searched update-loop unroll (algo.update_unroll)
             (state, replay_state), metrics = jax.lax.scan(
                 one_update,
                 (state, replay_state),
-                jax.random.split(ukey, self.algo.updates_per_iter),
+                ukeys,
+                unroll=self._update_unroll,
             )
             return state, replay_state, jax.tree.map(jnp.mean, metrics)
 
@@ -358,6 +453,8 @@ class OffPolicyTrainer:
         try:
             state, iteration, env_steps = hooks.restore(state)
             hooks.begin_run(iteration, env_steps)
+            if self.tune_decision.mode != "off":
+                hooks.tune_event(**self.tune_decision.telemetry())
             if not self.device_mode:
                 return self._run_host(
                     total, on_metrics, hooks, state, iteration, env_steps
@@ -366,30 +463,7 @@ class OffPolicyTrainer:
                 from surreal_tpu.parallel.mesh import replicate_state
 
                 state = replicate_state(self.mesh, state)
-            carry = self._init_carry(env_key)
-            if self.mesh is not None and self.mesh.size > 1:
-                # commit the carry with the shard_map's own specs at init
-                # (same reason as Trainer.run: an uncommitted carry breaks
-                # the first iteration's donation and pays a reshard)
-                from jax.sharding import NamedSharding, PartitionSpec as P
-
-                from surreal_tpu.parallel.dp import offpolicy_carry_specs
-
-                carry = jax.device_put(
-                    carry,
-                    jax.tree.map(
-                        lambda spec: NamedSharding(self.mesh, spec),
-                        offpolicy_carry_specs(carry),
-                        is_leaf=lambda x: isinstance(x, P),
-                    ),
-                )
-            example = self._replay_example()
-            if self.mesh is not None and self.mesh.size > 1:
-                from surreal_tpu.replay.sharded import sharded_replay_init
-
-                replay_state = sharded_replay_init(self.replay, example, self.mesh)
-            else:
-                replay_state = self.replay.init(example)
+            carry, replay_state = self.init_loop_state(env_key)
             if (
                 cfg.checkpoint.get("include_replay", False)
                 and hooks.ckpt is not None

@@ -64,12 +64,19 @@ def device_rollout(
     carry: RolloutCarry,
     key: jax.Array,
     horizon: int,
+    unroll: int = 1,
 ):
     """Collect ``horizon`` steps across B batched on-device envs.
 
     Returns (new_carry, batch) — batch has the learner batch contract plus
     ``ep_return``/``ep_done`` for metrics. Pure; callers jit it (usually
     fused with ``learner.learn``).
+
+    ``unroll`` is the rollout scan's unroll factor (``algo.rollout_unroll``
+    — a searched autotuner dimension, surreal_tpu/tune/space.py): the
+    graded workloads are latency-bound on exactly this scan of tiny
+    elementwise env ops, so trading program size for fewer sequential loop
+    iterations is measured per workload, not guessed.
     """
 
     def step(scan_carry, step_key):
@@ -111,7 +118,8 @@ def device_rollout(
     # segment-aligned (learn recomputes exactly this conditioning);
     # memoryless learners get None, which scans as an empty pytree
     (new_carry, _), batch = jax.lax.scan(
-        step, (carry, learner.act_init(carry.obs.shape[0])), keys
+        step, (carry, learner.act_init(carry.obs.shape[0])), keys,
+        unroll=max(1, min(int(unroll), horizon)),
     )
     return new_carry, batch
 

@@ -42,8 +42,22 @@ class Trainer:
         self.config = config
         self.env = make_env(training_env_config(config.env_config))
         self.learner = build_learner(config.learner_config, self.env.specs)
+        # program autotuner (surreal_tpu/tune/): consult the per-workload
+        # tuning cache (or search, algo.autotune='search') BEFORE any
+        # jitted program is built; a non-empty decision rewrites the
+        # learner overrides, so rebuild the learner from them
+        from surreal_tpu.tune import resolve_autotune
+
+        self.tune_decision = resolve_autotune(config, self.learner.config)
+        if self.tune_decision.applied:
+            self.learner = build_learner(config.learner_config, self.env.specs)
         # the learner holds the fully-extended tree (algo defaults applied)
         self.horizon = self.learner.config.algo.horizon
+        # searched rollout-scan unroll (tune/space.py dimension); `.get`
+        # keeps configs saved before the knob existed loadable
+        self._rollout_unroll = int(
+            self.learner.config.algo.get("rollout_unroll", 1)
+        )
         self.num_envs = config.env_config.num_envs
         self.device_mode = is_jax_env(self.env)
         self.seed = config.session_config.seed
@@ -154,7 +168,8 @@ class Trainer:
     ):
         ckey, lkey = jax.random.split(key)
         carry, batch = device_rollout(
-            self.env, self.learner, state, carry, ckey, self.horizon
+            self.env, self.learner, state, carry, ckey, self.horizon,
+            unroll=self._rollout_unroll,
         )
         learn_batch = {
             k: batch[k]
@@ -180,6 +195,28 @@ class Trainer:
         )
         metrics["episode/count"] = n_done.astype(jnp.float32)
         return state, carry, metrics
+
+    def init_loop_state(self, env_key: jax.Array) -> RolloutCarry:
+        """Device-mode rollout carry committed to the active mesh — ONE
+        constructor for run(), the autotuner's measurement harness
+        (tune/search.py), and tests, so none of them can drift from the
+        sharding/donation contract below."""
+        carry = init_device_carry(self.env, env_key, self.num_envs)
+        if getattr(self, "_sp_carry_sharding", None) is not None:
+            # dp x sp path: commit the env batch dp-sharded (all
+            # carry leaves lead with the env dim) so rollout work
+            # splits over dp instead of replicating
+            carry = jax.device_put(carry, self._sp_carry_sharding)
+        elif self.mesh is not None and self.mesh.size > 1:
+            # commit the carry dp-sharded at init so it matches
+            # the fused iter's in/out shardings from the FIRST
+            # call: an uncommitted carry forces a reshard whose
+            # source buffers cannot alias the output, silently
+            # dropping the donation for iteration 1
+            from surreal_tpu.parallel.mesh import batch_sharded
+
+            carry = jax.device_put(carry, batch_sharded(self.mesh))
+        return carry
 
     # -- main loop -----------------------------------------------------------
     def run(
@@ -210,23 +247,11 @@ class Trainer:
 
                 state = replicate_state(self.mesh, state)
             hooks.begin_run(iteration, env_steps)
+            if self.tune_decision.mode != "off":
+                hooks.tune_event(**self.tune_decision.telemetry())
 
             if self.device_mode:
-                carry = init_device_carry(self.env, env_key, self.num_envs)
-                if getattr(self, "_sp_carry_sharding", None) is not None:
-                    # dp x sp path: commit the env batch dp-sharded (all
-                    # carry leaves lead with the env dim) so rollout work
-                    # splits over dp instead of replicating
-                    carry = jax.device_put(carry, self._sp_carry_sharding)
-                elif self.mesh is not None and self.mesh.size > 1:
-                    # commit the carry dp-sharded at init so it matches
-                    # the fused iter's in/out shardings from the FIRST
-                    # call: an uncommitted carry forces a reshard whose
-                    # source buffers cannot alias the output, silently
-                    # dropping the donation for iteration 1
-                    from surreal_tpu.parallel.mesh import batch_sharded
-
-                    carry = jax.device_put(carry, batch_sharded(self.mesh))
+                carry = self.init_loop_state(env_key)
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
                     # span is UNFENCED (dispatch time): fencing here would

@@ -51,6 +51,14 @@ DDPG_LEARNER_CONFIG = Config(
             warmup_steps=2000,  # uniform-random actions before policy acting
         ),
         updates_per_iter=64,   # SGD updates per collect chunk (off-policy ratio)
+        update_unroll=1,       # update-loop scan unroll (searched autotuner
+                               # dimension — surreal_tpu/tune/space.py)
+        # uniform replay only: draw ALL updates_per_iter index sets in one
+        # batched gather before the update scan instead of one gather per
+        # scan step (record-equivalent — same keys, same indices; see
+        # OffPolicyTrainer._device_train_iter). Prioritized replay keeps
+        # the sequential path: priorities change between updates.
+        batched_uniform_sampling=True,
         horizon=16,            # collect chunk length per iteration
         use_layer_norm=True,
     ),

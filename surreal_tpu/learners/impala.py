@@ -185,6 +185,9 @@ class IMPALALearner(SequenceActingMixin, Learner):
                 clip_rho=algo.clip_rho,
                 clip_c=algo.clip_c,
                 clip_pg_rho=algo.clip_pg_rho,
+                # searched recurrence unroll (tune/space.py); clamped in
+                # the op. `.get` keeps pre-knob configs loadable
+                unroll=int(algo.get("gae_unroll", 1)),
             )
             pg_loss = -(vt.pg_advantages * logp).mean()
             v_loss = 0.5 * ((values - vt.vs) ** 2).mean()

@@ -73,6 +73,8 @@ PPO_LEARNER_CONFIG = Config(
                               # latency-bound backends) | 'pallas'
                               # (ops/pallas_gae fused kernel; interpret
                               # mode off-TPU)
+        sgd_unroll=1,         # minibatch-scan unroll inside _sgd_epochs
+                              # (searched autotuner dimension — tune/space.py)
         shuffle="block",      # minibatch shuffling: 'block' permutes
                               # contiguous blocks (the TPU-fast path —
                               # row gathers and 1M-element permutations
@@ -303,8 +305,11 @@ class PPOLearner(SequenceActingMixin, Learner):
             adv = delta_t + decay_t * carry
             return adv, adv
 
+        # unroll is the searched algo.gae_unroll (only this 'xla' path has
+        # a sequential scan to unroll; assoc/pallas restructure it instead)
         _, advs_rev = jax.lax.scan(
-            gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1])
+            gae_step, jnp.zeros_like(deltas[0]), (deltas[::-1], decay[::-1]),
+            unroll=max(1, min(int(algo.get("gae_unroll", 1)), deltas.shape[0])),
         )
         advantages = advs_rev[::-1]
         return advantages, advantages + values
@@ -438,21 +443,30 @@ class PPOLearner(SequenceActingMixin, Learner):
             )
             return (params, opt_state, stopped), aux
 
+        # searched minibatch-scan unroll (algo.sgd_unroll, tune/space.py);
+        # clamped to the scan length so an oversized cache entry from a
+        # wider geometry cannot fail the trace
+        sgd_unroll = max(1, min(int(algo.get("sgd_unroll", 1)), num_mb))
+
         def epoch_update(carry, epoch_key):
             # truncation covers row mode on domains not divisible by
             # num_mb; block mode divides exactly by construction
             perm = jax.random.permutation(epoch_key, perm_domain)
             perm = perm[: idx_shape[0] * idx_shape[1]]
             carry, auxs = jax.lax.scan(
-                mb_update, carry, perm.reshape(idx_shape)
+                mb_update, carry, perm.reshape(idx_shape), unroll=sgd_unroll
             )
             return carry, auxs
 
         epoch_keys = jax.random.split(key, algo.epochs)
+        # epoch scan: unroll=1 is the explicit decision — each epoch body
+        # already contains the whole minibatch scan, so unrolling here
+        # multiplies program size by epochs for no sequential-step savings
         return jax.lax.scan(
             epoch_update,
             (state.params, state.opt_state, jnp.asarray(False)),
             epoch_keys,
+            unroll=1,
         )
 
     def _finalize(

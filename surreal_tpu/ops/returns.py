@@ -27,6 +27,7 @@ def gae_advantages(
     discounts: jax.Array,
     values: jax.Array,
     lam: float,
+    unroll: int = 1,
 ) -> tuple[jax.Array, jax.Array]:
     """Generalized Advantage Estimation (reverse linear scan).
 
@@ -35,6 +36,8 @@ def gae_advantages(
       discounts: [T, ...]  (= gamma * (1 - done))
       values:    [T+1, ...] value estimates incl. bootstrap at index T
       lam:       GAE lambda
+      unroll:    scan unroll factor (``algo.gae_unroll`` — a searched
+                 autotuner dimension, surreal_tpu/tune/space.py)
 
     Returns:
       (advantages [T, ...], value_targets [T, ...]) where targets = adv + v.
@@ -51,6 +54,7 @@ def gae_advantages(
         step,
         jnp.zeros_like(deltas[0]),
         (deltas[::-1], decay[::-1]),
+        unroll=max(1, min(int(unroll), deltas.shape[0])),
     )
     advantages = advs_rev[::-1]
     return advantages, advantages + values[:-1]
@@ -135,7 +139,10 @@ def n_step_returns(
 
 
 def discounted_returns(
-    rewards: jax.Array, discounts: jax.Array, bootstrap_value: jax.Array
+    rewards: jax.Array,
+    discounts: jax.Array,
+    bootstrap_value: jax.Array,
+    unroll: int = 1,
 ) -> jax.Array:
     """Monte-Carlo discounted returns with bootstrap (eval/diagnostics)."""
 
@@ -144,5 +151,8 @@ def discounted_returns(
         ret = r_t + d_t * carry
         return ret, ret
 
-    _, rets_rev = lax.scan(step, bootstrap_value, (rewards[::-1], discounts[::-1]))
+    _, rets_rev = lax.scan(
+        step, bootstrap_value, (rewards[::-1], discounts[::-1]),
+        unroll=max(1, min(int(unroll), rewards.shape[0])),
+    )
     return rets_rev[::-1]

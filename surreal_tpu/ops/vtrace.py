@@ -37,6 +37,7 @@ def vtrace(
     clip_rho: float = 1.0,
     clip_c: float = 1.0,
     clip_pg_rho: float = 1.0,
+    unroll: int = 1,
 ) -> VTraceOutput:
     """Args:
       behaviour_logp: [T, ...] log pi_b(a_t | s_t) of the acting policy
@@ -45,6 +46,8 @@ def vtrace(
       discounts:      [T, ...] gamma * (1 - done)
       values:         [T+1, ...] learner value estimates incl. bootstrap
       clip_rho/clip_c/clip_pg_rho: IS-weight truncation levels (rho_bar etc.)
+      unroll: recurrence-scan unroll factor (``algo.gae_unroll`` — a
+        searched autotuner dimension, surreal_tpu/tune/space.py)
     """
     log_rhos = target_logp - behaviour_logp
     rhos = jnp.exp(log_rhos)
@@ -63,6 +66,7 @@ def vtrace(
         step,
         jnp.zeros_like(values[-1]),
         (deltas[::-1], discounts[::-1], cs[::-1]),
+        unroll=max(1, min(int(unroll), deltas.shape[0])),
     )
     vs_minus_v = acc_rev[::-1]
     vs = vs_minus_v + values[:-1]
@@ -116,6 +120,7 @@ def vtrace_nextobs(
     clip_rho: float = 1.0,
     clip_c: float = 1.0,
     clip_pg_rho: float = 1.0,
+    unroll: int = 1,
 ) -> VTraceOutput:
     """V-trace over auto-reset trajectories with exact truncation handling
     (the same two-mask scheme as the PPO learner's GAE):
@@ -127,7 +132,8 @@ def vtrace_nextobs(
       boundary (``done``), so corrections never leak across resets.
 
     All args are time-major [T, ...]; ``values``/``values_next`` are the
-    learner's V(s_t) / V(s'_t).
+    learner's V(s_t) / V(s'_t). ``unroll`` is the recurrence scan's unroll
+    factor (``algo.gae_unroll`` — a searched autotuner dimension).
     """
     log_rhos = target_logp - behaviour_logp
     rhos = jnp.exp(log_rhos)
@@ -148,6 +154,7 @@ def vtrace_nextobs(
         step,
         jnp.zeros_like(values[-1]),
         (deltas[::-1], edge[::-1], cs[::-1]),
+        unroll=max(1, min(int(unroll), deltas.shape[0])),
     )
     vs = acc_rev[::-1] + values
 

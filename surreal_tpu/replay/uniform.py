@@ -44,6 +44,33 @@ class UniformReplay:
         batch = ring_gather(state, idx)
         return state, batch, {"idx": idx}
 
+    def sample_many(
+        self, state: RingState, keys: jax.Array, batch_size: int | None = None
+    ):
+        """-> (state, batches [K, bs, ...], idx [K, bs]): all K index sets
+        drawn in one batched randint and gathered in ONE ring gather — the
+        off-policy update loop's fast path (the sequential form pays a
+        full-buffer gather dispatch per scan step; at the DDPG default
+        that is 64 sequential draws).
+
+        Record-equivalence contract: set k equals ``sample(state,
+        keys[k])`` bit-for-bit — same randint shape/bounds per key, same
+        storage gather — so the fused iteration's training record is
+        IDENTICAL either way (tested in tests/test_replay.py /
+        tests/test_tune.py). Uniform-only: the state doesn't change
+        between draws, which is exactly what prioritized replay violates.
+        """
+        bs = batch_size or self.batch_size
+        K = keys.shape[0]
+        idx = jax.vmap(
+            lambda k: jax.random.randint(k, (bs,), 0, jnp.maximum(state.size, 1))
+        )(keys)                                     # [K, bs]
+        flat = ring_gather(state, idx.reshape(-1))  # one gather for all sets
+        batches = jax.tree.map(
+            lambda x: x.reshape(K, bs, *x.shape[1:]), flat
+        )
+        return state, batches, idx
+
     # -- telemetry gauges (device scalars; see replay/base.py) ---------------
     def gauges(self, state: RingState) -> dict:
         return ring_gauges(state, self.capacity)

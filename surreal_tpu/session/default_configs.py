@@ -24,6 +24,20 @@ BASE_LEARNER_CONFIG = Config(
         # was acted more than this many updates ago (None = train on all;
         # V-trace absorbs bounded staleness, PPO-over-SEED should bound it)
         max_staleness=None,
+        # program autotuner (surreal_tpu/tune/): 'off' = hand-set knobs
+        # below; 'cache' = apply the tuning cache's winner for this
+        # workload fingerprint (falls back to defaults on a miss, never
+        # pays search cost); 'search' = on a miss, measure the candidate
+        # space at trainer build time and persist the winner (device
+        # jax:* envs only). `surreal_tpu tune <algo> <env>` runs the
+        # search standalone against the same cache.
+        autotune="off",
+        # searched scan-unroll knobs (tune/space.py declares the candidate
+        # values; every hot lax.scan states its decision explicitly —
+        # enforced by the test_import_hygiene unroll lint):
+        rollout_unroll=1,  # device rollout scan over the horizon
+        gae_unroll=1,      # time recurrences: PPO's xla GAE scan,
+                           # IMPALA's V-trace scan, ops/returns estimators
     ),
     model=Config(
         actor_hidden=(64, 64),
@@ -149,6 +163,13 @@ BASE_SESSION_CONFIG = Config(
     # spread on the pong workload. Hit/miss counts flow as
     # 'compile_cache' telemetry events (surfaced by `surreal_tpu diag`).
     compile_cache_dir=None,
+    # persistent JSON tuning cache (surreal_tpu/tune/cache.py), the
+    # compile cache's sibling: one entry per workload fingerprint holding
+    # the measured winner + its full trial record. Relative paths resolve
+    # under the session folder; None defaults to '<folder>/tuning_cache';
+    # an absolute path shares one cache across sessions (the pattern for
+    # `surreal_tpu tune` once + `algo.autotune='cache'` everywhere).
+    tuning_cache_dir=None,
     checkpoint=Config(
         every_n_iters=500,
         keep_last=3,

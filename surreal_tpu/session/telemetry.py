@@ -41,6 +41,12 @@ line, ``t`` = unix seconds):
      "shm_workers": N, "pickle_workers": M, "wire_bytes_per_step": B,
      ...}           (SEED drivers via SessionHooks.data_plane_event; the
                      last event reflects the settled negotiation)
+    {"type": "tune", "t": ..., "mode": "cache|search", "hit": ...,
+     "source": "...", "config": {...}, ["trials": [...], ...]}
+                    (autotuner decisions: trainers via
+                     SessionHooks.tune_event at build, the `surreal_tpu
+                     tune` CLI with full candidate timings; diag reports
+                     the last one plus hit/miss counts)
 
 Heartbeats live per rank in ``telemetry/heartbeat_rank<k>.jsonl``:
 
@@ -270,6 +276,8 @@ def diag_summary(folder: str) -> dict | None:
     health: dict[str, dict] = {}
     compile_cache = None
     data_plane = None
+    tune = None
+    tune_hits = tune_misses = 0
     nonfinite_windows = 0
     t_first = t_last = None
     last_step = None
@@ -302,6 +310,14 @@ def diag_summary(folder: str) -> dict | None:
             data_plane = {
                 k: v for k, v in ev.items() if k not in ("type", "t")
             }
+        elif ev.get("type") == "tune":
+            # the last event is the active decision; hit/miss counts
+            # accumulate over the session (trainer builds + CLI runs)
+            tune = {k: v for k, v in ev.items() if k not in ("type", "t")}
+            if ev.get("hit"):
+                tune_hits += 1
+            else:
+                tune_misses += 1
         elif ev.get("type") == "metrics":
             last_step = ev.get("step", last_step)
             vals = ev.get("values") or {}
@@ -340,6 +356,9 @@ def diag_summary(folder: str) -> dict | None:
         "health": health,
         "compile_cache": compile_cache,
         "data_plane": data_plane,
+        "tune": tune,
+        "tune_hits": tune_hits,
+        "tune_misses": tune_misses,
         "nonfinite_windows": nonfinite_windows,
         "heartbeats": heartbeats,
     }
@@ -398,6 +417,35 @@ def diag_report(folder: str) -> str | None:
             "Data plane — "
             + ", ".join(f"{k}={dpl[k]}" for k in sorted(dpl)),
         ]
+    tn = s.get("tune")
+    if tn is not None:
+        cfg = tn.get("config") or {}
+        lines += [
+            "",
+            f"Autotuner — mode={tn.get('mode')} "
+            f"source={tn.get('source')} "
+            f"{'cache hit' if tn.get('hit') else 'cache miss'} "
+            f"({s.get('tune_hits', 0)} hits / {s.get('tune_misses', 0)} "
+            "misses this session)",
+            "  config: "
+            + (
+                ", ".join(f"{k}={cfg[k]}" for k in sorted(cfg))
+                if cfg else "(static defaults)"
+            ),
+        ]
+        trials = tn.get("trials")
+        if trials:
+            lines.append(
+                f"  {len(trials)} candidates measured "
+                f"(default {tn.get('default_ms', 0):.2f} ms -> chosen "
+                f"{tn.get('chosen_ms', 0):.2f} ms/iter):"
+            )
+            for t in trials[:16]:
+                lines.append(
+                    f"    {t.get('iter_ms', 0):>9.2f} ms  {t.get('config')}"
+                )
+            if len(trials) > 16:
+                lines.append(f"    ... {len(trials) - 16} more")
     lines += ["", "Training health"]
     if s["health"]:
         lines.append(

@@ -1,0 +1,91 @@
+"""Workload fingerprint: the cache key of a tuning decision.
+
+A tuned program is only valid for the program it was measured on, so the
+fingerprint covers everything that changes the compiled iteration —
+algorithm + hyperparameters, model architecture, batch geometry (env name,
+num_envs, horizon live in the algo/env trees), replay shape, backend
+platform and device kind, and the jax version (XLA's scheduling changes
+across pins) — while EXCLUDING the searched knobs themselves: applying a
+cached winner must not change the key it was stored under, or a second
+lookup would miss its own result.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+# the searched dimensions (surreal_tpu/tune/space.py declares their
+# candidate values); excluded from the fingerprint along with the
+# autotune mode knob itself
+TUNABLE_KEYS = (
+    "rollout_unroll",
+    "sgd_unroll",
+    "update_unroll",
+    "gae_unroll",
+    "gae_impl",
+    "shuffle",
+)
+_EXCLUDED = TUNABLE_KEYS + ("autotune",)
+
+
+def fingerprint_dict(
+    extended_learner_config,
+    env_config,
+    platform: str | None = None,
+    device_kind: str | None = None,
+    jax_version: str | None = None,
+) -> dict:
+    """The human-readable fingerprint components (stored in each cache
+    entry so `cat <entry>.json` answers "tuned for WHAT?")."""
+    if platform is None or device_kind is None or jax_version is None:
+        import jax
+
+        platform = platform or jax.default_backend()
+        jax_version = jax_version or jax.__version__
+        if device_kind is None:
+            device_kind = str(jax.devices()[0].device_kind)
+    algo = {
+        k: v
+        for k, v in extended_learner_config.algo.to_dict().items()
+        if k not in _EXCLUDED
+    }
+    fp = {
+        "algo": algo,
+        "model": extended_learner_config.model.to_dict()
+        if "model" in extended_learner_config
+        else {},
+        "replay": extended_learner_config.replay.to_dict()
+        if "replay" in extended_learner_config
+        else {},
+        "optimizer": extended_learner_config.optimizer.to_dict()
+        if "optimizer" in extended_learner_config
+        else {},
+        "env": {
+            "name": env_config.name,
+            "num_envs": int(env_config.get("num_envs", 1)),
+            "action_repeat": env_config.get("action_repeat", 1),
+            "frame_stack": env_config.get("frame_stack", 1),
+            "image_size": env_config.get("image_size", None),
+        },
+        "backend": platform,
+        "device_kind": device_kind,
+        "jax": jax_version,
+    }
+    return fp
+
+
+def fingerprint_key(fp: dict) -> str:
+    """Stable 16-hex key of a fingerprint dict (sorted-key JSON; tuples
+    serialize as lists, so config-tree tuple/list spelling cannot fork
+    the key)."""
+    blob = json.dumps(fp, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def workload_fingerprint(
+    extended_learner_config, env_config, **kw
+) -> tuple[str, dict]:
+    """-> (key, fingerprint-dict). The one entry point callers use."""
+    fp = fingerprint_dict(extended_learner_config, env_config, **kw)
+    return fingerprint_key(fp), fp

@@ -1,0 +1,77 @@
+"""Declared candidate space per algorithm — the dimensions the search
+walks, in search order.
+
+Every dimension is a learner-config ``algo.*`` key that the trainers and
+learners already thread into their hot scans (the point of the autotuner
+PR: geometry knobs are searchable dimensions, not hand-tuned constants):
+
+- ``rollout_unroll`` — the device rollout ``lax.scan`` over the horizon
+  (launch/rollout.py, launch/offpolicy_trainer.py). The workloads are
+  latency-bound on exactly this scan; unrolling trades program size for
+  fewer sequential loop iterations.
+- ``gae_impl`` — PPO's advantage recurrence: 'xla' lax.scan | 'assoc'
+  log-depth associative_scan | 'pallas' fused kernel (ops/pallas_gae.py).
+  The pallas kernel is selected only when MEASURED faster on the live
+  backend — previously a manual config knob nobody flipped.
+- ``gae_unroll`` — unroll of the time recurrences themselves (PPO's xla
+  GAE scan, IMPALA's V-trace scan, the ops/returns.py estimators).
+- ``sgd_unroll`` — PPO's minibatch scan inside ``_sgd_epochs``.
+- ``update_unroll`` — the off-policy ``updates_per_iter`` sample+learn
+  scan (launch/offpolicy_trainer.py).
+- ``shuffle`` — PPO minibatch layout: 'block' (contiguous-block permute,
+  the measured TPU default) | 'row' (exact reference semantics).
+
+New geometry knobs join the search by adding a dimension here plus the
+key to fingerprint.TUNABLE_KEYS.
+"""
+
+from __future__ import annotations
+
+
+def candidate_space(extended_learner_config) -> list[tuple[str, list]]:
+    """[(dim_name, candidate_values)] in search order for this algo,
+    statically pruned to the workload's geometry (an unroll candidate
+    longer than the loop it unrolls is the same program re-measured)."""
+    algo = extended_learner_config.algo
+    name = algo.name
+    horizon = int(algo.get("horizon", 1))
+    dims: list[tuple[str, list]] = [
+        ("rollout_unroll", [u for u in (1, 2, 4, 8) if u <= horizon]),
+    ]
+    if name == "ppo":
+        dims.append(("gae_impl", ["xla", "assoc", "pallas"]))
+        dims.append(("gae_unroll", [u for u in (1, 2, 4) if u <= horizon]))
+        num_mb = int(algo.get("num_minibatches", 1))
+        dims.append(("sgd_unroll", [u for u in (1, 2, 4) if u <= num_mb]))
+        dims.append(("shuffle", ["block", "row"]))
+    elif name == "impala":
+        # V-trace recurrence unroll (the learn-phase scan IMPALA has)
+        dims.append(("gae_unroll", [u for u in (1, 2, 4) if u <= horizon]))
+    elif name == "ddpg":
+        upd = int(algo.get("updates_per_iter", 1))
+        dims.append(("update_unroll", [u for u in (1, 2, 4, 8) if u <= upd]))
+    return [(n, vals) for n, vals in dims if len(vals) > 1]
+
+
+def default_point(extended_learner_config) -> dict:
+    """The static-default value of every searched dimension — the
+    incumbent the search must beat, and the 'untuned arm' artifacts
+    record."""
+    algo = extended_learner_config.algo
+    return {
+        name: algo.get(name)
+        for name, _vals in candidate_space(extended_learner_config)
+    }
+
+
+def skip_dimension(name: str, incumbent: dict, extended_learner_config) -> bool:
+    """Prune dimensions made moot by the incumbent: ``gae_unroll`` only
+    exists inside PPO's 'xla' lax.scan path — under 'assoc'/'pallas' every
+    candidate compiles the identical program."""
+    if (
+        name == "gae_unroll"
+        and extended_learner_config.algo.name == "ppo"
+        and incumbent.get("gae_impl", "xla") != "xla"
+    ):
+        return True
+    return False

@@ -119,18 +119,19 @@ _DONATION_SCOPED_SOURCES = (
 )
 
 
-def _jit_call_spans(src: str):
-    """(line_number, call_text) for every ``jax.jit(`` call, text spanning
-    to the balanced closing paren (strings/comments not parsed — good
-    enough for a lint over our own style)."""
+def _call_spans(src: str, callee: str):
+    """(line_number, call_text) for every ``<callee>(`` call, text
+    spanning to the balanced closing paren (strings/comments not parsed —
+    good enough for a lint over our own style)."""
     spans = []
+    needle = callee + "("
     start = 0
     while True:
-        i = src.find("jax.jit(", start)
+        i = src.find(needle, start)
         if i < 0:
             return spans
         depth = 0
-        for j in range(i + len("jax.jit"), len(src)):
+        for j in range(i + len(callee), len(src)):
             if src[j] == "(":
                 depth += 1
             elif src[j] == ")":
@@ -139,6 +140,10 @@ def _jit_call_spans(src: str):
                     break
         spans.append((src.count("\n", 0, i) + 1, src[i : j + 1]))
         start = j + 1
+
+
+def _jit_call_spans(src: str):
+    return _call_spans(src, "jax.jit")
 
 
 def test_jitted_steps_declare_donation():
@@ -162,6 +167,41 @@ def test_jitted_steps_declare_donation():
         "donate_argnums (donate the loop-carried state, or declare "
         "donate_argnums=() and comment why the buffers stay aliased):\n"
         + "\n".join(bad)
+    )
+
+
+_UNROLL_SCOPED_SOURCES = (
+    # hot-loop scan modules (the autotuner PR's invariant): every
+    # ``lax.scan`` here runs inside (or is traced into) a training hot
+    # loop whose unroll factor the autotuner searches
+    # (surreal_tpu/tune/space.py) — rollout scans, the SGD/update loops,
+    # the GAE/V-trace recurrences
+    "learners",
+    "launch/rollout.py", "launch/trainer.py", "launch/offpolicy_trainer.py",
+    "ops/returns.py", "ops/vtrace.py",
+)
+
+
+def test_hot_scans_declare_unroll():
+    """Unroll-discipline lint (mirror of the donation lint above): a
+    ``lax.scan`` on a training hot path without an explicit ``unroll``
+    silently ships whatever jax defaults to, invisible to the autotuner
+    and to the next reader. Every call must state its decision — thread
+    the searched knob (``algo.rollout_unroll`` / ``sgd_unroll`` /
+    ``update_unroll`` / ``gae_unroll``), or pin ``unroll=1`` with the
+    reason the scan stays default."""
+    bad = []
+    for entry in _UNROLL_SCOPED_SOURCES:
+        root = _PKG_ROOT / entry
+        files = [root] if root.suffix == ".py" else sorted(root.rglob("*.py"))
+        for path in files:
+            for line, call in _call_spans(path.read_text(), "lax.scan"):
+                if "unroll" not in call:
+                    bad.append(f"{path.relative_to(_REPO_ROOT)}:{line}")
+    assert not bad, (
+        "lax.scan calls in hot-loop modules without an explicit unroll "
+        "decision (thread the searched algo.*_unroll knob, or state "
+        "unroll=1 and why):\n" + "\n".join(bad)
     )
 
 

@@ -46,6 +46,29 @@ def test_uniform_sample_respects_fill():
     assert int(info["idx"].max()) < 3  # never samples empty slots
 
 
+def test_uniform_sample_many_matches_sequential_draws():
+    """Record-equivalence contract of the batched fast path: set k of
+    ``sample_many(state, keys)`` must equal ``sample(state, keys[k])``
+    BIT-FOR-BIT (same randint shape/bounds per key, same storage gather) —
+    the off-policy update loop's one-gather path then trains on the
+    identical record as 64 sequential draws."""
+    replay = build_replay(
+        replay_cfg("uniform", capacity=64, batch_size=8, start_sample_size=1)
+    )
+    state = replay.init(jax.tree.map(lambda x: x[0], trans(1)))
+    state = replay.insert(state, trans(40))
+    keys = jax.random.split(jax.random.key(7), 5)
+    _, batches, idx = jax.jit(replay.sample_many)(state, keys)
+    assert idx.shape == (5, 8)
+    for k in range(5):
+        _, batch_k, info_k = replay.sample(state, keys[k])
+        np.testing.assert_array_equal(np.asarray(idx[k]), np.asarray(info_k["idx"]))
+        for name in batch_k:
+            np.testing.assert_array_equal(
+                np.asarray(batches[name][k]), np.asarray(batch_k[name])
+            )
+
+
 def test_fifo_dequeue_order_and_overwrite():
     replay = build_replay(replay_cfg("fifo", slots=2))
     traj = lambda v: {"obs": jnp.full((4, 2, 3), v, jnp.float32)}  # [T,B,...]
