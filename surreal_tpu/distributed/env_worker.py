@@ -27,6 +27,7 @@ import numpy as np
 import zmq
 
 from surreal_tpu.distributed import shm_transport as dp
+from surreal_tpu.utils import faults
 
 
 def _recv_reply(sock, stop_event, silence_s: float, steady: bool):
@@ -61,6 +62,7 @@ def run_env_worker(
     transport: str = "auto",
     pipeline: bool = False,
     server_silence_s: float = 120.0,
+    fault_plan: list | None = None,
 ) -> int:
     """Step envs against the inference server until ``max_steps`` or
     ``stop_event``. Returns total env steps executed.
@@ -73,10 +75,15 @@ def run_env_worker(
     ``pipeline``: split the env slice into two sub-slices and keep one
     sub-slice's request in flight while stepping the other.
     ``server_silence_s``: per-step liveness budget (was a hard-coded 120 s).
+    ``fault_plan``: chaos-harness plan for SPAWNED workers (their process
+    starts with an empty registry; thread workers share the trainer's and
+    must NOT pass one — reconfiguring would reset the shared counters).
     """
     from surreal_tpu.envs import make_env
     from surreal_tpu.session.config import Config
 
+    if fault_plan:
+        faults.configure(fault_plan)
     env_config = Config(env_config)
     num_envs = int(env_config.num_envs)
     n_slots = 2 if (pipeline and num_envs >= 2) else 1
@@ -95,6 +102,12 @@ def run_env_worker(
         ctx = zmq.Context.instance()
         sock = ctx.socket(zmq.DEALER)
         sock.setsockopt(zmq.IDENTITY, f"worker-{worker_id}".encode())
+        # bounded sends: a dead/wedged server eventually fills the DEALER's
+        # HWM, and an unbounded blocking send would hang this worker
+        # FOREVER — past every supervision signal. Bounding it by the same
+        # silence budget turns that hang into zmq.Again -> worker death ->
+        # supervisor respawn (the recovery path that actually exists).
+        sock.setsockopt(zmq.SNDTIMEO, max(1, int(server_silence_s * 1000)))
         sock.connect(server_address)
 
         for s, w in enumerate(widths):
@@ -131,6 +144,16 @@ def run_env_worker(
             sent_at[s] = time.monotonic()
         steady = False
         while not (stop_event is not None and stop_event.is_set()):
+            fault = faults.fire("env_worker.step")
+            if fault is not None:
+                if fault["kind"] == "kill_worker":
+                    # die like a real crash: the finally below releases the
+                    # socket/envs and the trainer's supervisor must respawn
+                    raise faults.FaultInjected(
+                        f"chaos: kill_worker (worker {worker_id})"
+                    )
+                if fault["kind"] == "delay":
+                    faults.sleep_ms(fault)
             t_wait0 = time.monotonic()
             payload = _recv_reply(sock, stop_event, server_silence_s, steady)
             if payload is None:

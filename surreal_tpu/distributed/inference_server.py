@@ -36,6 +36,7 @@ import numpy as np
 import zmq
 
 from surreal_tpu.distributed import shm_transport as dp
+from surreal_tpu.utils import faults
 
 
 class _WorkerTrack:
@@ -95,6 +96,7 @@ class InferenceServer:
         bind: str = "tcp://127.0.0.1:*",
         transport: str = "auto",
         auto_tune: bool = False,
+        sanitize_obs: bool = True,
     ):
         self._act_fn = act_fn
         self._act_lock = threading.Lock()
@@ -106,6 +108,15 @@ class InferenceServer:
             raise ValueError(f"transport {transport!r} not in auto|pickle")
         self.transport = transport
         self.auto_tune = bool(auto_tune)
+        # robustness: a nonfinite obs payload (corrupt slab slot, insane
+        # worker) is sanitized (np.nan_to_num copy) + counted instead of
+        # poisoning the shared micro-batch — one NaN row would otherwise
+        # contaminate the forward for EVERY coalesced worker and every
+        # trajectory assembled from it. Cost: one np.isfinite scan per
+        # request; disable via topology.sanitize_obs for maximal-throughput
+        # trusted planes.
+        self.sanitize_obs = bool(sanitize_obs)
+        self.sanitized_requests = 0
         self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
         # data-plane observability (SURVEY.md §5.5: the reference's
         # tensorplex tracked replay/fetch-queue occupancy): queue-full
@@ -353,6 +364,18 @@ class InferenceServer:
         requests = [r for r in requests if not r[1].get("final")]
         if not requests:
             return
+        f = faults.fire("server.serve")
+        if f is not None and f["kind"] == "delay":
+            faults.sleep_ms(f)  # a slow serve: drives worker silence budgets
+        if self.sanitize_obs:
+            for _, msg in requests:
+                o = msg["obs"]
+                if not np.isfinite(o).all():
+                    # copy (never write a worker's slab view) + clamp
+                    msg["obs"] = np.nan_to_num(
+                        np.asarray(o, dtype=o.dtype), copy=True
+                    )
+                    self.sanitized_requests += 1
         t0 = time.monotonic()
         if len(requests) == 1:
             # fast path (the steady state at min_batch=1): a lone pending
@@ -518,6 +541,7 @@ class InferenceServer:
             "server/queue_depth": float(self.chunks.qsize()),
             "server/evicted_chunks": float(self.evicted_chunks),
             "server/evicted_steps": float(self.evicted_steps),
+            "server/sanitized_requests": float(self.sanitized_requests),
         }
         # the two EWMAs are assigned non-atomically by the server thread;
         # guard each on its own (a shared guard can race float(None))

@@ -22,12 +22,14 @@ independent server shards with deterministic client->shard routing.
 from __future__ import annotations
 
 import threading
+import time
 import zlib
 from typing import Any, Sequence
 
 import zmq
 
 from surreal_tpu.distributed.module_dict import dumps_pytree, loads_pytree
+from surreal_tpu.utils import faults
 
 
 class ParameterPublisher:
@@ -106,6 +108,13 @@ class ParameterServer:
                             self._latest = (int.from_bytes(ver, "little"), blob)
                 elif sock is self._rep:
                     req = self._rep.recv()
+                    f = faults.fire("param_service.reply")
+                    if f is not None and f["kind"] == "delay_reply":
+                        # chaos: stall past the client's timeout (REQ/REP
+                        # forbids a true drop — the REP socket must answer
+                        # to stay usable; the abandoned reply is discarded
+                        # by zmq when the client's old socket is gone)
+                        faults.sleep_ms(f)
                     with self._lock:
                         latest = self._latest
                     if latest is None:
@@ -198,7 +207,7 @@ class ParameterClient:
         self.template = template
         self.version = 0
 
-    def _request(self, payload: bytes, timeout_ms: int):
+    def _request_once(self, payload: bytes, timeout_ms: int):
         self._req.send(payload)
         if not self._req.poll(timeout_ms):
             self._req.close(0)
@@ -207,29 +216,58 @@ class ParameterClient:
             raise TimeoutError("parameter server did not reply")
         return self._req.recv_multipart()
 
-    def fetch(self, timeout_ms: int = 5000) -> Any | None:
+    def _request(
+        self, payload: bytes, timeout_ms: int, retries: int, backoff_s: float
+    ):
+        """Bounded-retry request (ISSUE 5 satellite): a dead/stalled peer
+        costs ``retries`` timeouts with exponential backoff between
+        attempts, then raises — never an unbounded wait. Each timeout
+        already RECOVERS the REQ socket (a strict REQ with an outstanding
+        send would fail every later attempt with EFSM)."""
+        attempts = max(0, int(retries)) + 1
+        for attempt in range(attempts):
+            try:
+                return self._request_once(payload, timeout_ms)
+            except TimeoutError:
+                if attempt == attempts - 1:
+                    raise TimeoutError(
+                        f"parameter server at {self._address} did not reply "
+                        f"in {attempts} attempt(s) of {timeout_ms} ms"
+                    ) from None
+                time.sleep(backoff_s * (2.0 ** attempt))
+
+    def fetch(
+        self,
+        timeout_ms: int = 5000,
+        retries: int = 2,
+        backoff_s: float = 0.25,
+    ) -> Any | None:
         """Returns the latest params pytree, or None when there is nothing
         NEW for this client — nothing published yet, or the server's
         version equals the last one fetched (the request carries
         ``self.version``, so an unchanged server answers ``b"unchanged"``
         without shipping or re-decompressing the blob; callers keep their
-        current params either way). Raises TimeoutError on a silent
-        server — after RECOVERING the REQ socket (a strict REQ with an
-        outstanding send would fail every later fetch with EFSM), so
-        callers may simply retry."""
+        current params either way). A silent server costs ``retries``
+        bounded, backed-off re-attempts and then raises TimeoutError —
+        an actor against a dead session fails loudly instead of blocking
+        its episode loop forever."""
         ver, blob = self._request(
-            b"fetch?" + self.version.to_bytes(8, "little"), timeout_ms
+            b"fetch?" + self.version.to_bytes(8, "little"),
+            timeout_ms, retries, backoff_s,
         )
         if ver in (b"none", b"unchanged"):
             return None
         self.version = int.from_bytes(ver, "little")
         return loads_pytree(self.template, blob)
 
-    def peek_version(self, timeout_ms: int = 5000) -> int:
+    def peek_version(
+        self, timeout_ms: int = 5000, retries: int = 0, backoff_s: float = 0.25
+    ) -> int:
         """Latest PUBLISHED version without transferring the blob (0 if
         nothing published yet) — the cheap poll for wait-until-version
-        loops. Does not advance :attr:`version` (nothing was fetched)."""
-        ver, _ = self._request(b"version", timeout_ms)
+        loops (which own their retry cadence, hence ``retries=0`` here).
+        Does not advance :attr:`version` (nothing was fetched)."""
+        ver, _ = self._request(b"version", timeout_ms, retries, backoff_s)
         return 0 if ver == b"none" else int.from_bytes(ver, "little")
 
     def close(self) -> None:

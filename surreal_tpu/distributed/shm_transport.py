@@ -58,6 +58,8 @@ from typing import Any, Sequence
 
 import numpy as np
 
+from surreal_tpu.utils import faults
+
 # Control frames are single ZMQ frames prefixed with MAGIC; pickled dicts
 # (protocol 5 starts b"\x80\x05") can never collide with it, so one
 # payload sniff routes both transports through the same server loop.
@@ -281,7 +283,11 @@ def attach_slab(name: str, owner_pid: int | None = None) -> shared_memory.Shared
         from multiprocessing import resource_tracker
 
         resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:  # pragma: no cover - tracker API moved
+    except (ImportError, AttributeError, KeyError, OSError):
+        # tracker API moved / registration absent on this interpreter:
+        # worst case is the pre-3.13 double-track unlink this guard
+        # papers over — named narrowly so real failures still surface
+        # (tests/test_import_hygiene.py bans blanket except-pass here)
         pass
     return shm
 
@@ -310,6 +316,16 @@ class PickleWorkerTransport:
         out = dict(msg, slot=int(slot))
         if final:
             out["final"] = True
+        f = faults.fire("transport.send")
+        if f is not None:
+            if f["kind"] == "drop_frame":
+                return  # swallowed on the wire; the silence budget recovers
+            if f["kind"] == "delay_frame":
+                faults.sleep_ms(f)
+            elif f["kind"] == "corrupt_slab" and "obs" in out:
+                # pickle analogue of a corrupt slab slot: poison the
+                # payload copy (not the env's own buffer)
+                out["obs"] = faults.corrupt_array(np.array(out["obs"]))
         self._sock.send(encode_pickle_msg(out), zmq.NOBLOCK if noblock else 0)
 
     def decode_reply(self, payload: bytes) -> tuple[int, np.ndarray]:
@@ -351,6 +367,14 @@ class ShmWorkerTransport:
         lat = msg.get("act_latency_ms")
         if lat is not None:
             flags |= F_HAS_GAUGES
+        f = faults.fire("transport.send")
+        if f is not None:
+            if f["kind"] == "drop_frame":
+                return  # slab written, control frame swallowed
+            if f["kind"] == "delay_frame":
+                faults.sleep_ms(f)
+            elif f["kind"] == "corrupt_slab":
+                faults.corrupt_array(v["obs"])  # in place: it IS the slab
         frame = encode_step(
             slot, flags, lat or 0.0, msg.get("pipeline_occupancy", 0.0),
             msg.get("episode_returns", ()), msg.get("episode_lengths", ()),

@@ -23,11 +23,14 @@ import time
 
 import jax
 
+from surreal_tpu.launch.recovery import RecoveryManager
 from surreal_tpu.session.checkpoint import CheckpointManager, make_checkpoint_manager
 from surreal_tpu.session.config import Config
+from surreal_tpu.session.interrupt import InterruptSentinel
 from surreal_tpu.session.metrics import get_logger, make_metrics_writer
 from surreal_tpu.session.telemetry import Tracer
 from surreal_tpu.session.tracker import PeriodicTracker
+from surreal_tpu.utils import faults
 
 
 def maybe_enable_compile_cache(session_cfg) -> str | None:
@@ -100,8 +103,23 @@ class SessionHooks:
             self.log.info(
                 "persistent compile cache at %s", self.compile_cache_dir
             )
-        self.ckpt: CheckpointManager | None = make_checkpoint_manager(cfg)
+        self.ckpt: CheckpointManager | None = make_checkpoint_manager(
+            cfg, on_event=self.tracer.event
+        )
         self._ckpt_every = PeriodicTracker(max(1, cfg.checkpoint.every_n_iters))
+        # robustness layer (ISSUE 5): the preemption sentinel latches
+        # SIGTERM/SIGINT and end_iteration turns it into a stop at the
+        # next boundary — the driver's normal final checkpoint then IS the
+        # emergency checkpoint, at most one iteration behind the signal.
+        # The recovery manager is the divergence-guard policy on PR 1's
+        # in-graph health/* signals (launch/recovery.py). `.get` keeps
+        # configs saved before the knobs existed loadable.
+        rec = cfg.get("recovery", None)
+        self.interrupt = InterruptSentinel(
+            enabled=bool(rec.get("interrupt", True)) if rec is not None else True
+        )
+        self.recovery = RecoveryManager(config, self.ckpt, self.tracer, self.log)
+        self._interrupt_logged = False
         # optional step-aligned auxiliary state (the off-policy trainer
         # sets this to snapshot its replay buffer when
         # checkpoint.include_replay is on); zero-arg callable -> pytree
@@ -227,9 +245,14 @@ class SessionHooks:
         folder; restore_from only seeds the very first run."""
         cfg = self.config.session_config.checkpoint
         if cfg.auto_resume and self.ckpt is not None:
-            restored = self.ckpt.restore(init_state)
+            # newest FINITE checkpoint, not merely the newest readable one:
+            # in warn mode (multi-host) a poisoned run-end save can exist,
+            # and resuming into it would re-trip forever — the walk skips
+            # damaged AND nonfinite steps (launch/recovery.py), emitting
+            # recovery telemetry for each skip
+            restored = self.recovery.restore_newest_finite(init_state)
             if restored is not None:
-                state, meta = restored
+                state, meta, _step = restored
                 self.log.info(
                     "auto-resumed at iteration %d (%d env steps)",
                     meta["iteration"], meta["env_steps"],
@@ -237,7 +260,7 @@ class SessionHooks:
                 self._reseed_cadences(int(meta["iteration"]))
                 return state, int(meta["iteration"]), int(meta["env_steps"])
         if cfg.restore_from:
-            mgr = CheckpointManager(cfg.restore_from)
+            mgr = CheckpointManager(cfg.restore_from, on_event=self.tracer.event)
             restored = mgr.restore(init_state)
             mgr.close()
             if restored is None:
@@ -307,6 +330,7 @@ class SessionHooks:
             return state_box[0]
 
         m = None
+        trip_reason = None
         if self._metrics_every.track_increment():
             # the ONE device->host sync of the cadence window: float() on
             # the device scalars blocks until the dispatched iterations
@@ -320,7 +344,27 @@ class SessionHooks:
             )
             self._last_train = m
             self._emit_cache_event()
-        if self._publisher is not None and self._pub_every.track_increment():
+            # divergence guard: the health/* scalars just synced are the
+            # detection signal (launch/recovery.py); in rollback mode a
+            # trip sets recovery.pending, which the DRIVER resolves via
+            # rollback()
+            trip_reason = self.recovery.check(m, iteration, env_steps)
+        # skip the state-consuming side-bands while the guard is tripped in
+        # BOTH rollback and warn modes (warn is the multi-host setting — a
+        # poisoned save would make auto_resume restore the poison).
+        # last_window_tripped PERSISTS between cadence windows, so publish/
+        # eval/checkpoint cadences firing on off-metrics iterations are
+        # covered too; it clears on the next healthy window or rollback.
+        tripped = (
+            trip_reason is not None
+            or self.recovery.pending is not None
+            or self.recovery.last_window_tripped is not None
+        )
+        if (
+            self._publisher is not None
+            and self._pub_every.track_increment()
+            and not tripped  # never publish poisoned params to live actors
+        ):
             with self.tracer.span("param-publish", emit=True):
                 version = self._publisher.publish(
                     self._pub_agent.acting_view(resolve_state())
@@ -329,7 +373,11 @@ class SessionHooks:
                 m["publish/version"] = float(version)
                 self._last_train = m
         evaled: dict[str, float] = {}
-        if self.evaluator is not None and self._eval_every.track_increment():
+        if (
+            self.evaluator is not None
+            and self._eval_every.track_increment()
+            and not tripped  # a poisoned state's eval is wasted episodes
+        ):
             with self.tracer.span("eval", emit=True):
                 evaled = self.evaluator.evaluate(resolve_state(), key)
             self._last_eval = evaled
@@ -344,24 +392,73 @@ class SessionHooks:
             self.writer.write(env_steps, {**(m or {}), **evaled})
             self.tracer.log_metrics(env_steps, {**(m or {}), **evaled})
         if self.ckpt is not None and self._ckpt_every.track_increment():
-            with self.tracer.span("checkpoint", emit=True):
-                self.ckpt.save(
-                    iteration,
-                    resolve_state(),
-                    env_steps=env_steps,
-                    metrics=self.last_metrics,
+            if tripped:
+                # a tripped window's state must never become "last good" —
+                # the rollback about to happen would restore the poison
+                self.log.warning(
+                    "skipping checkpoint at iteration %d: divergence guard "
+                    "tripped this window", iteration,
                 )
-                if self.extra_state_fn is not None:
-                    self.ckpt.save_extra(iteration, self.extra_state_fn())
+            else:
+                with self.tracer.span("checkpoint", emit=True):
+                    self.ckpt.save(
+                        iteration,
+                        resolve_state(),
+                        env_steps=env_steps,
+                        metrics=self.last_metrics,
+                    )
+                    if self.extra_state_fn is not None:
+                        self.ckpt.save_extra(iteration, self.extra_state_fn())
         self._profiler_tick(iteration)
+        # chaos-harness visibility: mirror any faults fired since the last
+        # boundary into the telemetry spine (empty list in normal runs)
+        for ev in faults.drain_fired():
+            self.tracer.event("fault", **ev)
         stop = m is not None and on_metrics is not None and bool(
             on_metrics(iteration, m)
         )
+        if self.interrupt.fired:
+            # preemption-safe shutdown: stop at THIS boundary; the driver's
+            # final_checkpoint is the emergency save (no handler ever
+            # touches orbax — session/interrupt.py)
+            if not self._interrupt_logged:
+                self._interrupt_logged = True
+                self.log.warning(
+                    "interrupt (signal %s) latched: stopping after iteration "
+                    "%d, emergency checkpoint follows",
+                    self.interrupt.signum, iteration,
+                )
+                self.tracer.event(
+                    "recovery", kind="interrupt",
+                    signum=self.interrupt.signum,
+                    iteration=int(iteration), env_steps=int(env_steps),
+                )
+            stop = True
         return m, stop
 
+    @property
+    def interrupted(self) -> bool:
+        """True once the preemption sentinel latched a signal — loops with
+        iteration paths that bypass ``end_iteration`` (the SEED stale-drop
+        path) poll this so an interrupt cannot get stuck behind a streak."""
+        return self.interrupt.fired
+
     def final_checkpoint(self, iteration: int, env_steps: int, state) -> None:
-        """Always leave a resumable checkpoint at run end. ``state`` may be
-        a zero-arg callable (see ``end_iteration``)."""
+        """Always leave a resumable checkpoint at run end — including the
+        interrupt path, where this IS the emergency checkpoint. ``state``
+        may be a zero-arg callable (see ``end_iteration``). Skipped when
+        the divergence guard is pending OR the last synced window tripped
+        (the warn-mode spelling, where pending is never set — multi-host):
+        persisting poison would make the relaunch resume into the same
+        NaNs the guard just caught."""
+        if self.recovery.pending is not None or self.recovery.last_window_tripped:
+            self.log.warning(
+                "skipping final checkpoint: divergence guard %s "
+                "(relaunch will resume from the last finite checkpoint)",
+                "pending" if self.recovery.pending else "tripped on the "
+                "last synced window",
+            )
+            return
         if self.ckpt is not None and self.ckpt.latest_step() != iteration:
             self.ckpt.save(
                 iteration,
@@ -404,6 +501,9 @@ class SessionHooks:
             self.log.info("profiler trace stopped")
 
     def close(self) -> None:
+        self.interrupt.close()  # restore the process's previous handlers
+        for ev in faults.drain_fired():  # tail faults since the last boundary
+            self.tracer.event("fault", **ev)
         if self._prof_active:
             jax.profiler.stop_trace()
             self._prof_active = False

@@ -179,8 +179,23 @@ class _MultiHostSession:
         """Rank-0 session prologue shared by every multi-host run():
         restore on rank 0 -> broadcast to all ranks -> replicate over the
         mesh -> start counters. Returns (hooks, state, iteration,
-        env_steps); hooks is None on ranks > 0."""
+        env_steps); hooks is None on ranks > 0.
+
+        Preemption discipline: a preempting scheduler SIGTERMs the whole
+        group. Rank 0's hooks own an interrupt sentinel and turn the latch
+        into a stop that ``_maybe_agree_stop`` broadcasts at the next
+        metrics-cadence iteration (interrupt latency is bounded by
+        ``metrics.every_n_iters``); ranks > 0 install a latch-only
+        sentinel here so the default SIGTERM handler cannot kill them
+        mid-collective while rank 0 still needs their participation for
+        that agreement (a second signal escalates, session/interrupt.py).
+        Divergence ROLLBACK is downgraded to 'warn' on rank 0: restoring
+        is a collective operation these loops cannot run per-rank — the
+        multi-host recovery story is kill-and-relaunch with auto_resume,
+        which now lands on the last FINITE checkpoint (the poisoned-save
+        skip still applies)."""
         hooks = SessionHooks(self.config, self.learner) if self.rank == 0 else None
+        self._rank_interrupt = None
         if hooks is None:
             # ranks > 0 never construct hooks, but every process compiles
             # the same programs — enable the persistent compile cache here
@@ -188,6 +203,17 @@ class _MultiHostSession:
             from surreal_tpu.launch.hooks import maybe_enable_compile_cache
 
             maybe_enable_compile_cache(self.config.session_config)
+            from surreal_tpu.session.interrupt import InterruptSentinel
+
+            rec = self.config.session_config.get("recovery", None)
+            self._rank_interrupt = InterruptSentinel(
+                enabled=bool(rec.get("interrupt", True)) if rec is not None else True
+            )
+        else:
+            hooks.recovery.disable_rollback(
+                "multi-host run: per-rank restore would desynchronize the "
+                "collective schedule; relaunch with auto_resume instead"
+            )
         try:
             iteration, env_steps = 0, 0
             if hooks is not None:
@@ -207,14 +233,17 @@ class _MultiHostSession:
         return hooks, state, iteration, env_steps
 
     def _end_session(self, hooks, iteration: int, env_steps: int, lazy_host_state):
-        """Run-end epilogue: rank 0 writes the final checkpoint, then ALL
-        ranks leave the collective schedule together (rank 0 may still be
-        writing while others would otherwise tear down the runtime)."""
+        """Run-end epilogue: rank 0 writes the final checkpoint (the
+        emergency checkpoint, on the interrupt path), then ALL ranks leave
+        the collective schedule together (rank 0 may still be writing
+        while others would otherwise tear down the runtime)."""
         if hooks is not None:
             hooks.final_checkpoint(iteration, env_steps, lazy_host_state)
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("surreal_tpu:run_end")
+        if self._rank_interrupt is not None:
+            self._rank_interrupt.close()
         return hooks.last_metrics if hooks is not None else {}
 
 
@@ -646,6 +675,9 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                         **{
                             "staleness/updates_behind": float(staleness),
                             "workers/respawns": float(plane.respawns),
+                            "workers/respawn_backoff_s": float(
+                                plane.respawn_backoff_s
+                            ),
                             "server/chunk_age_s": float(plane.last_chunk_age_s),
                         },
                         **server.queue_stats(),

@@ -32,6 +32,7 @@ from surreal_tpu.learners import build_learner
 from surreal_tpu.learners.aggregator import nstep_transitions
 from surreal_tpu.learners.ddpg import ou_noise_step
 from surreal_tpu.replay import build_replay
+from surreal_tpu.utils import faults
 
 
 class OffPolicyCarry(NamedTuple):
@@ -191,11 +192,10 @@ class OffPolicyTrainer:
             tail=tail,
         )
 
-    def init_loop_state(self, env_key: jax.Array):
-        """(carry, replay_state) committed to the active mesh — ONE
-        constructor for run(), the autotuner's measurement harness
-        (tune/search.py), and tests, so none of them can drift from the
-        dp path's sharding/donation contract."""
+    def committed_carry(self, env_key: jax.Array) -> OffPolicyCarry:
+        """Fresh rollout carry committed to the active mesh — shared by
+        init_loop_state and the divergence-rollback path (which re-seeds
+        the env carry without re-allocating the replay storage)."""
         carry = self._init_carry(env_key)
         if self.mesh is not None and self.mesh.size > 1:
             # commit the carry with the shard_map's own specs at init
@@ -213,6 +213,14 @@ class OffPolicyTrainer:
                     is_leaf=lambda x: isinstance(x, P),
                 ),
             )
+        return carry
+
+    def init_loop_state(self, env_key: jax.Array):
+        """(carry, replay_state) committed to the active mesh — ONE
+        constructor for run(), the autotuner's measurement harness
+        (tune/search.py), and tests, so none of them can drift from the
+        dp path's sharding/donation contract."""
+        carry = self.committed_carry(env_key)
         example = self._replay_example()
         if self.mesh is not None and self.mesh.size > 1:
             from surreal_tpu.replay.sharded import sharded_replay_init
@@ -449,6 +457,12 @@ class OffPolicyTrainer:
         key = jax.random.key(self.seed)
         key, init_key, env_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
+        # chaos harness: install (or RESET) the fault registry for this run
+        faults.configure_from(self.config.session_config)
+        # divergence-rollback fallback when no finite checkpoint exists yet
+        self._fresh_init = lambda nonce: self.learner.init(
+            jax.random.fold_in(init_key, nonce)
+        )
         hooks = SessionHooks(self.config, self.learner)
         try:
             state, iteration, env_steps = hooks.restore(state)
@@ -479,8 +493,14 @@ class OffPolicyTrainer:
                     )
                     if restored is not None:
                         replay_state = restored["replay"]
+            include_replay = bool(
+                cfg.checkpoint.get("include_replay", False)
+            ) and hooks.ckpt is not None
             first_call = True
             while env_steps < total:
+                f = faults.fire("trainer.iteration")
+                if f is not None:
+                    state = faults.apply_trainer_fault(f, state)
                 key, it_key, hk_key = jax.random.split(key, 3)
                 beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
                 warmup = jnp.asarray(
@@ -498,6 +518,32 @@ class OffPolicyTrainer:
                 _, stop = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics
                 )
+                if hooks.recovery.pending:
+                    rb = hooks.recovery.rollback(
+                        state, fresh=self._fresh_init,
+                        # replay rides the rollback when it was snapshotted;
+                        # otherwise the buffer is kept — its contents are
+                        # DATA (worst case: some poisoned-policy transitions
+                        # that re-trip the bounded guard), not parameters
+                        extra_template=(
+                            {"replay": replay_state} if include_replay else None
+                        ),
+                    )
+                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
+                    if self.mesh is not None and self.mesh.size > 1:
+                        from surreal_tpu.parallel.mesh import replicate_state
+
+                        state = replicate_state(self.mesh, state)
+                    if rb.extra is not None:
+                        replay_state = rb.extra["replay"]
+                    key = jax.random.fold_in(key, rb.nonce)
+                    carry = self.committed_carry(
+                        jax.random.fold_in(env_key, rb.nonce)
+                    )
+                    # the fresh carry's n-step tail is fabricated again:
+                    # re-scrub the first folded chunk after the rollback
+                    first_call = True
+                    continue
                 if stop:
                     break
             hooks.final_checkpoint(iteration, env_steps, state)
@@ -644,9 +690,16 @@ class OffPolicyTrainer:
         prefetch = (
             Prefetcher(collect_chunk, name="offpolicy-stage") if overlap else None
         )
+        include_replay = bool(
+            ckpt_cfg.get("include_replay", False)
+        ) and hooks.ckpt is not None
         first_chunk = True
         try:
             while env_steps < total:
+                f = faults.fire("trainer.iteration")
+                if f is not None:
+                    state = faults.apply_trainer_fault(f, state)
+                    act_holder[0] = state
                 if prefetch is not None:
                     with hooks.tracer.span("chunk-wait"):
                         traj, ep_returns = prefetch.get()
@@ -708,6 +761,24 @@ class OffPolicyTrainer:
                     iteration, env_steps, state, hk_key,
                     host_metrics(metrics, recent_returns), on_metrics,
                 )
+                if hooks.recovery.pending:
+                    rb = hooks.recovery.rollback(
+                        state, fresh=self._fresh_init,
+                        extra_template=(
+                            {"replay": replay_state} if include_replay else None
+                        ),
+                    )
+                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
+                    if rb.extra is not None:
+                        replay_state = rb.extra["replay"]
+                    # staging thread keeps collecting: hand it the restored
+                    # acting state + rolled-back step count; chunks already
+                    # staged from the poisoned policy are data the replay
+                    # (and the bounded guard) absorb
+                    act_holder[0] = state
+                    steps_holder[0] = env_steps
+                    key = jax.random.fold_in(key, rb.nonce)
+                    continue
                 if stop:
                     break
             hooks.final_checkpoint(iteration, env_steps, state)

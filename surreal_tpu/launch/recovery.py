@@ -1,0 +1,262 @@
+"""Divergence guard + rollback policy (the robustness layer's second leg;
+ISSUE 5 tentpole).
+
+PR 1 gave every learner in-graph ``health/*`` diagnostics — grad/param
+norms and a NaN/inf guard — that ride the metrics dict and sync to host
+at the existing ``metrics.every_n_iters`` cadence (zero extra
+device->host syncs). This module is the POLICY on those signals: when a
+synced window shows ``health/nonfinite > 0`` (or an optional grad-norm
+limit exceeded), the run does not die and does not keep training on
+poisoned state; it
+
+1. **skips the poisoned save** — ``SessionHooks`` consults the guard
+   before its checkpoint cadence fires, so a NaN state can never become
+   the "last good" checkpoint;
+2. **rolls back** — the driver restores the newest checkpoint whose
+   state is actually finite (older steps are tried if the newest restored
+   one is itself poisoned — possible when the checkpoint cadence outpaces
+   the metrics cadence), plus the replay ``extra/`` tree on the
+   off-policy path when it was snapshotted;
+3. **re-seeds the offending batch** — drivers fold the rollback count
+   into their PRNG chain and env carries, so a deterministic workload
+   cannot replay the exact trajectory into the same divergence;
+4. **applies bounded LR backoff** — writes
+   ``max(min_lr_scale, lr_backoff ** nonce)`` into the restored state's
+   :class:`~surreal_tpu.learners.base.RecoveryScaleState` leaves (a
+   traced input of the jitted learn, so no rebuild/recompile).
+
+After ``recovery.max_rollbacks`` failed recoveries the run raises
+:class:`TrainingDiverged` — a bounded, loud end beats an unbounded
+restore loop. Detection latency is the metrics cadence (the health
+scalars only reach the host there); bound the damage by keeping
+``metrics.every_n_iters <= checkpoint.every_n_iters``, which the
+defaults satisfy.
+
+Multi-host note: rollback is deliberately single-host. A collective
+restore would need every rank to agree on the rollback inside the
+collective schedule (the same deadlock shape as per-rank staleness
+drops, see MultiHostSEEDTrainer); multi-host runs set the guard to
+``warn`` — the trip is logged/emitted, the poisoned checkpoint is still
+skipped on rank 0, and the recovery story is kill-and-relaunch with
+``auto_resume`` (which now lands on the last FINITE checkpoint).
+
+Config (``session_config.recovery``): ``interrupt`` (the
+session/interrupt.py sentinel), ``on_divergence`` ('rollback' | 'warn' |
+'off'), ``max_rollbacks``, ``lr_backoff``, ``min_lr_scale``,
+``grad_norm_limit``. Telemetry: every trip/rollback/giveup lands as a
+``recovery`` event rendered by ``surreal_tpu diag``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from surreal_tpu.learners.base import set_recovery_lr_scale
+
+
+class TrainingDiverged(RuntimeError):
+    """Raised when the divergence guard exhausted its rollback budget (or
+    had no checkpoint and no fresh-init fallback to roll back to)."""
+
+
+class RollbackResult(NamedTuple):
+    state: Any
+    iteration: int
+    env_steps: int
+    extra: Any | None     # restored auxiliary tree (replay), when asked for
+    nonce: int            # rollback count — drivers fold this into PRNG chains
+    lr_scale: float
+
+
+def _state_is_finite(state: Any) -> bool:
+    """One host sync, rollback-path only: NaN/inf anywhere in the inexact
+    leaves? (isfinite-of-sum — inf/nan propagate through the reduction, so
+    one scalar check covers each leaf.)"""
+    checks = [
+        jnp.isfinite(jnp.sum(x))
+        for x in jax.tree.leaves(state)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.inexact)
+    ]
+    if not checks:
+        return True
+    return bool(jnp.all(jnp.stack(checks)))
+
+
+class RecoveryManager:
+    """One per :class:`~surreal_tpu.launch.hooks.SessionHooks`. The hooks
+    call :meth:`check` on every synced metrics window (setting
+    ``pending``); the DRIVER — which owns the state/carry/replay — calls
+    :meth:`rollback` when it observes ``pending`` and splices the result
+    back into its loop."""
+
+    def __init__(self, config, ckpt, tracer, log):
+        rc = config.session_config.get("recovery", None)
+        get = rc.get if rc is not None else (lambda k, d=None: d)
+        self.mode = get("on_divergence", "rollback")
+        if self.mode not in ("rollback", "warn", "off"):
+            raise ValueError(
+                f"recovery.on_divergence {self.mode!r} not in rollback|warn|off"
+            )
+        self.max_rollbacks = int(get("max_rollbacks", 3))
+        self.lr_backoff = float(get("lr_backoff", 0.5))
+        self.min_lr_scale = float(get("min_lr_scale", 0.05))
+        self.heal_after_windows = int(get("heal_after_windows", 20))
+        limit = get("grad_norm_limit", None)
+        self.grad_norm_limit = None if limit is None else float(limit)
+        self.rollbacks = 0
+        self.pending: str | None = None   # trip reason awaiting the driver
+        # what the MOST RECENT synced window showed (None = healthy):
+        # final_checkpoint consults this in warn mode, where pending is
+        # never set but a poisoned run-end save must still be refused
+        self.last_window_tripped: str | None = None
+        self._healthy_streak = 0
+        self._trip_iteration: int | None = None
+        self._ckpt = ckpt
+        self._tracer = tracer
+        self._log = log
+
+    def disable_rollback(self, reason: str) -> None:
+        """Downgrade to 'warn' (multi-host drivers: rollback is a
+        collective restore these loops cannot run — see module doc)."""
+        if self.mode == "rollback":
+            self.mode = "warn"
+            self._log.info("divergence rollback disabled: %s", reason)
+
+    # -- detection (called by SessionHooks at the metrics cadence) -----------
+    def check(self, metrics, iteration: int, env_steps: int) -> str | None:
+        """Inspect one synced metrics window; returns the trip reason (and
+        sets ``pending`` in rollback mode) or None."""
+        if self.mode == "off" or not metrics:
+            return None
+        reason = None
+        if metrics.get("health/nonfinite", 0.0) > 0.0:
+            reason = "nonfinite"
+        elif (
+            self.grad_norm_limit is not None
+            and metrics.get("health/grad_norm", 0.0) > self.grad_norm_limit
+        ):
+            reason = "grad_norm"
+        self.last_window_tripped = reason
+        if reason is None:
+            # healing: the rollback budget targets a state that RE-diverges,
+            # not isolated transients spread over a production-length run —
+            # sustained healthy windows clear the streak (the same reset
+            # rule the SEED respawn backoff applies to worker crash loops).
+            # The backed-off lr_scale persists until the NEXT rollback
+            # recomputes it from the reset nonce: raising it mid-run would
+            # mean mutating the driver's live state from a policy object.
+            self._healthy_streak += 1
+            if self.rollbacks and self._healthy_streak >= self.heal_after_windows:
+                self._log.info(
+                    "divergence guard healed: %d healthy windows since the "
+                    "last rollback — clearing the rollback streak (%d)",
+                    self._healthy_streak, self.rollbacks,
+                )
+                self._tracer.event(
+                    "recovery", kind="healed", rollbacks_cleared=self.rollbacks,
+                    healthy_windows=self._healthy_streak,
+                )
+                self.rollbacks = 0
+            return None
+        self._healthy_streak = 0
+        self._trip_iteration = iteration
+        self._log.warning(
+            "divergence guard tripped at iteration %d (%s: nonfinite=%s "
+            "grad_norm=%s) — mode=%s",
+            iteration, reason, metrics.get("health/nonfinite"),
+            metrics.get("health/grad_norm"), self.mode,
+        )
+        self._tracer.event(
+            "recovery", kind="tripped", reason=reason, mode=self.mode,
+            iteration=int(iteration), env_steps=int(env_steps),
+            grad_norm=metrics.get("health/grad_norm"),
+        )
+        if self.mode == "rollback":
+            self.pending = reason
+        return reason
+
+    # -- rollback (called by the driver that owns the loop state) ------------
+    def rollback(
+        self, template_state: Any, *, fresh=None, extra_template: Any | None = None
+    ) -> RollbackResult:
+        """Restore the newest FINITE checkpoint and clear ``pending``.
+
+        ``template_state`` supplies the restore pytree structure (the
+        driver's current — poisoned — state is fine). ``fresh(nonce)``
+        builds a from-scratch state when no usable checkpoint exists (the
+        guard tripped before the first save): the run restarts at
+        iteration 0 rather than dying. ``extra_template`` asks for the
+        step-aligned auxiliary tree (the off-policy replay snapshot) from
+        the same step. Raises :class:`TrainingDiverged` when the bounded
+        budget is exhausted or no recovery source exists.
+        """
+        reason, self.pending = self.pending or "manual", None
+        # the poisoned state is being replaced with a finite one: the
+        # last-window flag no longer describes the live state (a run that
+        # ends right after a rollback may still final-checkpoint)
+        self.last_window_tripped = None
+        self.rollbacks += 1
+        nonce = self.rollbacks
+        if self.rollbacks > self.max_rollbacks:
+            self._tracer.event(
+                "recovery", kind="giveup", reason=reason, rollbacks=self.rollbacks,
+            )
+            raise TrainingDiverged(
+                f"divergence guard tripped {self.rollbacks} times "
+                f"(recovery.max_rollbacks={self.max_rollbacks}); the last-"
+                "good checkpoint re-diverges even with LR backoff — "
+                "inspect `surreal_tpu diag` health signals"
+            )
+        restored = self.restore_newest_finite(template_state)
+        extra = None
+        if restored is not None:
+            state, meta, step = restored
+            iteration, env_steps = int(meta["iteration"]), int(meta["env_steps"])
+            source = f"checkpoint step {step}"
+            if extra_template is not None and self._ckpt is not None:
+                extra = self._ckpt.restore_extra(extra_template, step=step)
+        elif fresh is not None:
+            state, iteration, env_steps = fresh(nonce), 0, 0
+            source = "fresh init (no finite checkpoint existed)"
+        else:
+            self._tracer.event("recovery", kind="giveup", reason=reason)
+            raise TrainingDiverged(
+                "divergence guard tripped with no finite checkpoint to "
+                "roll back to and no fresh-init fallback"
+            )
+        lr_scale = max(self.min_lr_scale, self.lr_backoff ** nonce)
+        state = set_recovery_lr_scale(state, lr_scale)
+        self._log.warning(
+            "rollback #%d (%s): resumed from %s at iteration %d "
+            "(%d env steps), lr scale %.3g — offending batch re-seeded",
+            nonce, reason, source, iteration, env_steps, lr_scale,
+        )
+        self._tracer.event(
+            "recovery", kind="rollback", reason=reason, nonce=nonce,
+            from_iteration=self._trip_iteration, to_iteration=iteration,
+            env_steps=env_steps, lr_scale=lr_scale, source=source,
+            extra_restored=extra is not None,
+        )
+        return RollbackResult(state, iteration, env_steps, extra, nonce, lr_scale)
+
+    def restore_newest_finite(self, template_state):
+        """Newest checkpoint whose state is actually FINITE — one walk for
+        the rollback path AND auto-resume (SessionHooks.restore): a
+        relaunch after a kill must land on the last finite checkpoint, not
+        merely the last readable one. Delegates to the CheckpointManager's
+        own damage-fallback walk with a finiteness ``validate`` hook (one
+        source of truth for skip/raise semantics: damaged steps fall back
+        with telemetry, an every-step restore failure raises the newest
+        error loudly, poison-everywhere returns None). Returns
+        (state, meta, step) or None; the saved step IS ``meta['iteration']``
+        (CheckpointManager.save's contract)."""
+        if self._ckpt is None:
+            return None
+        restored = self._ckpt.restore(template_state, validate=_state_is_finite)
+        if restored is None:
+            return None
+        state, meta = restored
+        return state, meta, int(meta["iteration"])

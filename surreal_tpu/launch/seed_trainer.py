@@ -35,6 +35,7 @@ import numpy as np
 from surreal_tpu.distributed.env_worker import run_env_worker
 from surreal_tpu.distributed.inference_server import InferenceServer
 from surreal_tpu.learners import build_learner
+from surreal_tpu.utils import faults
 
 
 _FROM_CONFIG = object()  # sentinel: None is a meaningful max_staleness value
@@ -51,7 +52,14 @@ class _DataPlane:
     tunneled TPU; in the multi-host loop the steady wait also covers the
     slowest rank's fleet, since the learn is collective)."""
 
-    def __init__(self, trainer, server, workers, env_cfg, stop, first_timeout):
+    # a respawn that survives this long clears its worker's failure streak
+    # (the exponential backoff below targets CRASH LOOPS, not one-off kills)
+    _HEALTHY_S = 10.0
+
+    def __init__(
+        self, trainer, server, workers, env_cfg, stop, first_timeout,
+        respawn_backoff_s: float = 0.5, respawn_backoff_cap_s: float = 30.0,
+    ):
         self.trainer = trainer
         self.server = server
         self.workers = workers
@@ -61,16 +69,55 @@ class _DataPlane:
         self._timeout = first_timeout
         self.steady_timeout = 30.0
         self.last_chunk_age_s = 0.0  # queue dwell of the last chunk served
+        # exponential respawn backoff (satellite of ISSUE 5): a worker that
+        # dies at startup used to respawn-loop hot — burning CPU on env
+        # construction and flooding the server with hellos. First death
+        # respawns immediately; consecutive deaths back off base * 2^k up
+        # to the cap; a respawn that survives _HEALTHY_S resets its streak.
+        self._backoff_base = float(respawn_backoff_s)
+        self._backoff_cap = float(respawn_backoff_cap_s)
+        now = time.monotonic()
+        self._failures = [0] * len(workers)
+        self._next_spawn_at = [0.0] * len(workers)
+        self._spawned_at = [now] * len(workers)
+        self.respawn_backoff_s = 0.0  # gauge: backoff set by the last respawn
         # supervision runs from the prefetch staging thread (empty-poll
         # waits) AND the trainer thread (drop path / post-learn): without
         # the lock both could respawn the same dead worker
         self._supervise_lock = threading.Lock()
 
     def supervise(self) -> None:
+        """Workers are expendable (SURVEY.md §5.3: the reference delegated
+        actor recovery to Kubernetes restart policies; here the trainer IS
+        the supervisor): any dead worker is replaced in-place, under the
+        backoff schedule above. Safe because workers are stateless — a
+        fresh worker re-opens its DEALER socket under the same identity
+        and the server's first message from it (obs-only) replaces the
+        stale pending state without fabricating a transition."""
         with self._supervise_lock:
-            self.respawns += self.trainer._respawn_dead_workers(
-                self.workers, self.env_cfg, self.server.address, self.stop
-            )
+            now = time.monotonic()
+            for i, w in enumerate(self.workers):
+                if w.is_alive():
+                    if (
+                        self._failures[i]
+                        and now - self._spawned_at[i] > self._HEALTHY_S
+                    ):
+                        self._failures[i] = 0
+                    continue
+                if now < self._next_spawn_at[i]:
+                    continue  # backing off a crash-looping worker
+                self.workers[i] = self.trainer._spawn_one(
+                    i, self.env_cfg, self.server.address, self.stop
+                )
+                self.respawns += 1
+                self._failures[i] += 1
+                self._spawned_at[i] = now
+                backoff = min(
+                    self._backoff_cap,
+                    self._backoff_base * (2.0 ** (self._failures[i] - 1)),
+                )
+                self._next_spawn_at[i] = now + backoff
+                self.respawn_backoff_s = backoff
 
     def next_chunk(self) -> dict:
         deadline = time.monotonic() + self._timeout
@@ -187,6 +234,9 @@ class SEEDTrainer:
             else self.transport
         )
         self.worker_silence_s = float(topo.get("worker_silence_s", 120.0))
+        # chaos harness: worker indices whose FIRST process spawn already
+        # carried the fault plan (see _spawn_one's respawn note)
+        self._fault_plan_sent: set[int] = set()
         n_envs = int(config.env_config.num_envs)
         # pipelined sub-slices halve the per-chunk batch width, so the
         # learn program compiles once per width: keep widths uniform (even
@@ -258,6 +308,18 @@ class SEEDTrainer:
         if self.worker_mode == "process":
             import multiprocessing as mp
 
+            # chaos harness: a spawned worker starts with an empty fault
+            # registry — forward the plan so worker-site injections
+            # (kill_worker, drop_frame, corrupt_slab) reach process mode
+            # too; thread workers share this process's registry already.
+            # FIRST spawn per index only: a respawned process would restart
+            # its call counters at zero and re-fire one-shot faults forever
+            # (a kill_worker injection must kill once, not crash-loop the
+            # respawn path it exists to test)
+            plan = faults.get().plan
+            if plan and i not in self._fault_plan_sent:
+                kwargs["fault_plan"] = plan
+                self._fault_plan_sent.add(i)
             ctx = mp.get_context("spawn")
             w = ctx.Process(
                 target=run_env_worker,
@@ -281,21 +343,6 @@ class SEEDTrainer:
             for i in range(self.num_workers)
         ]
 
-    def _respawn_dead_workers(self, workers, env_cfg, address, stop) -> int:
-        """Workers are expendable (SURVEY.md §5.3: the reference delegated
-        actor recovery to Kubernetes restart policies; here the trainer IS
-        the supervisor): any dead worker is replaced in-place. Safe because
-        workers are stateless — a fresh worker re-opens its DEALER socket
-        under the same identity and the server's first message from it
-        (obs-only) replaces the stale pending state without fabricating a
-        transition."""
-        respawned = 0
-        for i, w in enumerate(workers):
-            if not w.is_alive():
-                workers[i] = self._spawn_one(i, env_cfg, address, stop)
-                respawned += 1
-        return respawned
-
     def _start_data_plane(self, act_fn, stop, first_chunk_timeout: float):
         """Spawn the inference server + worker fleet and return a
         :class:`_DataPlane` handle — the shared lifecycle for the
@@ -303,6 +350,7 @@ class SEEDTrainer:
         teardown live in ONE place)."""
         from surreal_tpu.launch.hooks import training_env_config
 
+        topo = self.config.session_config.topology
         server = InferenceServer(
             act_fn=act_fn,
             unroll_length=self.algo.horizon,
@@ -316,6 +364,11 @@ class SEEDTrainer:
             max_wait_ms=5.0,
             transport="pickle" if self.worker_transport == "pickle" else "auto",
             auto_tune=True,
+            # robustness: nonfinite obs payloads (a corrupt slab slot, a
+            # worker gone insane) are sanitized + counted rather than
+            # poisoning the whole micro-batch. `.get` keeps old configs
+            # loadable.
+            sanitize_obs=bool(topo.get("sanitize_obs", True)),
         )
         try:
             env_cfg = self._worker_env_config(
@@ -326,7 +379,11 @@ class SEEDTrainer:
             # a failed spawn must not leak the ROUTER socket + serve thread
             server.close()
             raise
-        return _DataPlane(self, server, workers, env_cfg, stop, first_chunk_timeout)
+        return _DataPlane(
+            self, server, workers, env_cfg, stop, first_chunk_timeout,
+            respawn_backoff_s=float(topo.get("respawn_backoff_s", 0.5)),
+            respawn_backoff_cap_s=float(topo.get("respawn_backoff_cap_s", 30.0)),
+        )
 
     def _worker_env_config(self, env_cfg):
         """Hook: per-rank seed decorrelation in the multi-host subclass."""
@@ -366,6 +423,11 @@ class SEEDTrainer:
         key = jax.random.key(cfg.seed)
         key, init_key, act_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
+        # chaos harness: install (or RESET) the fault registry for this run
+        faults.configure_from(cfg)
+        self._fresh_init = lambda nonce: self.learner.init(
+            jax.random.fold_in(init_key, nonce)
+        )
         from surreal_tpu.launch.hooks import SessionHooks
 
         hooks = SessionHooks(self.config, self.learner)
@@ -435,12 +497,16 @@ class SEEDTrainer:
                     "staleness/dropped_chunks": float(dropped_stale),
                     "staleness/steps_discarded": float(discarded_steps),
                     "workers/respawns": float(plane.respawns),
+                    "workers/respawn_backoff_s": float(plane.respawn_backoff_s),
                     "server/chunk_age_s": float(plane.last_chunk_age_s),
                     **server.queue_stats(),
                     **(server.episode_stats() or {}),
                 }
 
             while env_steps < total:
+                f = faults.fire("trainer.iteration")
+                if f is not None:
+                    state = faults.apply_trainer_fault(f, state)
                 with hooks.tracer.span("chunk-wait"):
                     batch, versions, n_steps = prefetch.get()
                 staleness = server.version - int(versions.min())
@@ -463,6 +529,10 @@ class SEEDTrainer:
                     env_steps += n_steps
                     discarded_steps += n_steps
                     plane.supervise()
+                    if hooks.interrupted:
+                        # this path never reaches end_iteration's stop —
+                        # a preemption must not sit out a stale streak
+                        break
                     continue
                 key, lkey, hk_key = jax.random.split(key, 3)
                 with hooks.tracer.span("learn"):
@@ -490,6 +560,19 @@ class SEEDTrainer:
                 _, stop_flag = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics
                 )
+                if hooks.recovery.pending:
+                    rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
+                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
+                    if self.mesh is not None:
+                        from surreal_tpu.parallel.mesh import replicate_state
+
+                        state = replicate_state(self.mesh, state)
+                    # the live act closure aliases the poisoned state:
+                    # re-arm acting from the restored one immediately (the
+                    # version bump also marks in-flight chunks stale)
+                    server.set_act_fn(self._make_act_fn(state, key_holder))
+                    key = jax.random.fold_in(key, rb.nonce)
+                    continue
                 if stop_flag:
                     break
             # the drop path consumes budget without firing the metrics

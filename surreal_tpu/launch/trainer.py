@@ -32,6 +32,7 @@ from surreal_tpu.launch.rollout import (
     init_device_carry,
 )
 from surreal_tpu.learners import build_learner
+from surreal_tpu.utils import faults
 
 
 class Trainer:
@@ -237,6 +238,13 @@ class Trainer:
         key = jax.random.key(self.seed)
         key, init_key, env_key = jax.random.split(key, 3)
         state = self.learner.init(init_key)
+        # chaos harness: install (or RESET) the fault registry for this run
+        faults.configure_from(self.config.session_config)
+        # divergence-rollback fallback when no finite checkpoint exists yet:
+        # restart from a nonce-distinct init (launch/recovery.py)
+        self._fresh_init = lambda nonce: self.learner.init(
+            jax.random.fold_in(init_key, nonce)
+        )
         hooks = SessionHooks(self.config, self.learner)
         try:
             state, iteration, env_steps = hooks.restore(state)
@@ -253,6 +261,9 @@ class Trainer:
             if self.device_mode:
                 carry = self.init_loop_state(env_key)
                 while env_steps < total:
+                    f = faults.fire("trainer.iteration")
+                    if f is not None:
+                        state = faults.apply_trainer_fault(f, state)
                     key, it_key, hk_key = jax.random.split(key, 3)
                     # span is UNFENCED (dispatch time): fencing here would
                     # serialize the async pipeline; window totals are
@@ -267,6 +278,21 @@ class Trainer:
                     _, stop = hooks.end_iteration(
                         iteration, env_steps, state, hk_key, metrics, on_metrics
                     )
+                    if hooks.recovery.pending:
+                        rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
+                        state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
+                        if self.mesh is not None and self.mesh.size > 1:
+                            from surreal_tpu.parallel.mesh import replicate_state
+
+                            state = replicate_state(self.mesh, state)
+                        # re-seed the offending batch: roll the key chain
+                        # and the env carry so a deterministic workload
+                        # cannot replay into the same divergence
+                        key = jax.random.fold_in(key, rb.nonce)
+                        carry = self.init_loop_state(
+                            jax.random.fold_in(env_key, rb.nonce)
+                        )
+                        continue
                     if stop:
                         break
             else:
@@ -299,6 +325,9 @@ class Trainer:
         obs = self.env.reset(seed=self.config.env_config.seed)
         recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
         while env_steps < total:
+            f = faults.fire("trainer.iteration")
+            if f is not None:
+                state = faults.apply_trainer_fault(f, state)
             key, r_key, l_key, hk_key = jax.random.split(key, 4)
             with hooks.tracer.span("rollout"):
                 obs, batch, ep_stats = host_rollout(
@@ -313,6 +342,16 @@ class Trainer:
                 iteration, env_steps, state, hk_key,
                 host_metrics(metrics, recent_returns), on_metrics,
             )
+            if hooks.recovery.pending:
+                rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
+                state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
+                key = jax.random.fold_in(key, rb.nonce)
+                # a NaN policy steps the env into garbage: reset it on a
+                # nonce-distinct seed (the re-seeded offending batch)
+                obs = self.env.reset(
+                    seed=self.config.env_config.seed + rb.nonce
+                )
+                continue
             if stop:
                 break
         return state, iteration, env_steps
@@ -370,6 +409,10 @@ class Trainer:
         recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
         try:
             while env_steps < total:
+                f = faults.fire("trainer.iteration")
+                if f is not None:
+                    state = faults.apply_trainer_fault(f, state)
+                    act_state[0] = state
                 with tracer.span("chunk-wait"):
                     got = out.get()
                 if isinstance(got, BaseException):
@@ -386,6 +429,21 @@ class Trainer:
                     iteration, env_steps, state, hk_key,
                     host_metrics(metrics, recent_returns), on_metrics,
                 )
+                if hooks.recovery.pending:
+                    rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
+                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
+                    act_state[0] = state  # collector acts healthy again
+                    key = jax.random.fold_in(key, rb.nonce)
+                    # drop any queued rollout collected by the poisoned
+                    # policy (data, not params — but no reason to learn on
+                    # it); the collector's own env obs cannot be reset from
+                    # here, so a run whose ENV state went nonfinite re-trips
+                    # and exhausts the bounded budget loudly
+                    try:
+                        out.get_nowait()
+                    except queue_mod.Empty:
+                        pass
+                    continue
                 if stop:
                     break
         finally:

@@ -20,7 +20,7 @@ pytree (session/checkpoint.py); the driver loop lives in launch/trainer.py.
 from __future__ import annotations
 
 import abc
-from typing import Any, Mapping
+from typing import Any, Mapping, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +64,70 @@ def training_health(old_params, new_params, grad_norm: jax.Array) -> dict:
         "health/update_ratio": update_norm / (old_norm + 1e-12),
         "health/nonfinite": 1.0 - finite.astype(jnp.float32),
     }
+
+
+class RecoveryScaleState(NamedTuple):
+    """State of :func:`recovery_scale`: one f32 scalar, 1.0 until a
+    divergence rollback backs it off (launch/recovery.py)."""
+
+    scale: jax.Array
+
+
+def recovery_scale() -> optax.GradientTransformation:
+    """Final link of every learner's optimizer chain: multiply the update
+    by a state-resident scalar (1.0 by default, i.e. a no-op).
+
+    This is the bounded-LR-backoff mechanism of the divergence-rollback
+    policy: because the scalar lives in the optimizer state it is a
+    *traced input* to the jitted learn program, so the recovery layer can
+    shrink the effective learning rate between iterations by rewriting one
+    leaf of the restored checkpoint — no learner rebuild, no recompile,
+    and schedules (linear anneal) compose since the scale multiplies
+    whatever update the upstream chain produced.
+    """
+
+    def init_fn(params):
+        del params
+        return RecoveryScaleState(scale=jnp.ones((), jnp.float32))
+
+    def update_fn(updates, state, params=None):
+        del params
+        return jax.tree.map(lambda u: u * state.scale, updates), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def set_recovery_lr_scale(tree: Any, scale) -> Any:
+    """Write ``scale`` into every :class:`RecoveryScaleState` leaf of a
+    learner-state pytree (all optimizer chains at once — DDPG carries
+    two). Host-side, between iterations only; a no-op for trees without
+    the link. Each leaf gets its OWN scalar array: sharing one buffer
+    across leaves would make a donating fused iteration see the same
+    buffer twice in its flattened arguments — a hard XLA error."""
+    is_leaf = lambda n: isinstance(n, RecoveryScaleState)  # noqa: E731
+    return jax.tree.map(
+        lambda n: (
+            RecoveryScaleState(scale=jnp.full((), scale, jnp.float32))
+            if is_leaf(n) else n
+        ),
+        tree,
+        is_leaf=is_leaf,
+    )
+
+
+def get_recovery_lr_scale(tree: Any) -> float | None:
+    """Current recovery LR scale (first link found), or None when the tree
+    predates / lacks the link. One device->host sync; telemetry-path only."""
+    found: list = []
+    is_leaf = lambda n: isinstance(n, RecoveryScaleState)  # noqa: E731
+
+    def visit(n):
+        if is_leaf(n):
+            found.append(n.scale)
+        return n
+
+    jax.tree.map(visit, tree, is_leaf=is_leaf)
+    return float(found[0]) if found else None
 
 
 class Learner(abc.ABC):

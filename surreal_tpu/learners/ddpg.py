@@ -22,7 +22,12 @@ import numpy as np
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
-from surreal_tpu.learners.base import TRAINING, Learner, training_health
+from surreal_tpu.learners.base import (
+    TRAINING,
+    Learner,
+    recovery_scale,
+    training_health,
+)
 from surreal_tpu.models.ddpg_net import DDPGActor, DDPGCritic
 from surreal_tpu.ops.running_stats import (
     RunningStats,
@@ -88,13 +93,18 @@ class DDPGLearner(Learner):
         self.critic = DDPGCritic(
             model_cfg=model_cfg, use_layer_norm=learner_config.algo.use_layer_norm
         )
+        # recovery_scale: divergence-rollback LR backoff (learners/base.py)
+        # — a no-op scale-by-1 until launch/recovery.py backs it off; on
+        # BOTH chains so a rollback slows actor and critic together
         self.actor_tx = optax.chain(
             optax.clip_by_global_norm(learner_config.optimizer.max_grad_norm),
             optax.adam(learner_config.algo.actor_lr),
+            recovery_scale(),
         )
         self.critic_tx = optax.chain(
             optax.clip_by_global_norm(learner_config.optimizer.max_grad_norm),
             optax.adam(learner_config.algo.critic_lr),
+            recovery_scale(),
         )
 
     # -- state ---------------------------------------------------------------

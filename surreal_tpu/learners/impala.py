@@ -21,7 +21,12 @@ import numpy as np
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
-from surreal_tpu.learners.base import TRAINING, Learner, training_health
+from surreal_tpu.learners.base import (
+    TRAINING,
+    Learner,
+    recovery_scale,
+    training_health,
+)
 from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
@@ -90,6 +95,8 @@ class IMPALALearner(SequenceActingMixin, Learner):
         self.tx = optax.chain(
             optax.clip_by_global_norm(opt_cfg.max_grad_norm),
             optax.adam(lr),
+            # divergence-rollback LR backoff (see learners/base.py)
+            recovery_scale(),
         )
 
     def init(self, key: jax.Array) -> IMPALAState:

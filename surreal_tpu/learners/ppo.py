@@ -35,6 +35,7 @@ from surreal_tpu.learners.base import (
     EVAL_DETERMINISTIC,
     TRAINING,
     Learner,
+    recovery_scale,
     training_health,
 )
 from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
@@ -163,6 +164,10 @@ class PPOLearner(SequenceActingMixin, Learner):
         return optax.chain(
             optax.clip_by_global_norm(opt_cfg.max_grad_norm),
             optax.adam(lr),
+            # divergence-rollback LR backoff (learners/base.py): a no-op
+            # scale-by-1 until launch/recovery.py writes a backed-off value
+            # into the restored state
+            recovery_scale(),
         )
 
     # -- state ---------------------------------------------------------------

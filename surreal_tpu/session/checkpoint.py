@@ -37,7 +37,12 @@ class CheckpointManager:
         keep_last: int = 3,
         keep_best: bool = True,
         best_key: str = "episode/return",
+        on_event=None,
     ):
+        # on_event(type_str, **fields): optional telemetry sink (the
+        # session tracer's .event) — restore-fallback decisions must be
+        # visible in `surreal_tpu diag`, not only in a log file
+        self._on_event = on_event
         self.directory = os.path.join(os.path.abspath(folder), "checkpoints")
         os.makedirs(self.directory, exist_ok=True)
         self.keep_best = keep_best
@@ -147,6 +152,11 @@ class CheckpointManager:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def steps(self) -> list[int]:
+        """All retained step numbers, ascending (includes steps whose dirs
+        may be damaged — restore() is where damage is discovered)."""
+        return sorted(int(s) for s in self._mgr.all_steps())
+
     def best_metric(self) -> dict | None:
         if not os.path.exists(self._best_meta_path):
             return None
@@ -158,23 +168,71 @@ class CheckpointManager:
                 # "no best yet" rather than poisoning every future save
                 return None
 
-    def restore(self, template_state: Any, step: int | None = None):
+    def restore(self, template_state: Any, step: int | None = None,
+                validate=None):
         """Restore (state, meta) at ``step`` (default latest).
 
         ``template_state`` supplies the pytree structure/shardings to
         restore into — call sites pass a freshly ``init()``-ed state.
         Returns None when no checkpoint exists.
+
+        Damage fallback: without an explicit ``step``, a latest step dir
+        that fails to restore (truncated/corrupt — a SIGKILL mid-save is
+        a supported failure, and relaunch-after-kill is exactly when this
+        path runs) falls back to the next-older retained step instead of
+        crashing the relaunch, emitting a ``recovery`` telemetry event
+        (kind ``checkpoint_fallback``). ``validate(state) -> bool`` lets
+        callers reject restorable-but-unusable steps (the divergence
+        layer passes a finiteness check so a save that raced the NaN
+        detection window never becomes the resume point); rejected steps
+        emit kind ``skipped_nonfinite_checkpoint`` and the walk continues.
+        If steps exist but NONE restores (every dir raised), the walk
+        raises the NEWEST step's error — an every-step failure is
+        systemic (e.g. the template's optimizer layout changed) and a
+        silent fresh start would overwrite the very progress the caller
+        asked to resume. All-rejected-by-validate returns None (poison
+        everywhere is genuinely unresumable; callers fall back to fresh
+        init). An explicit ``step`` is a caller decision and propagates
+        its error directly.
         """
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return None
         template = {
             "state": template_state,
             "meta": {"iteration": 0, "env_steps": 0},
         }
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-        payload = self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-        return payload["state"], payload["meta"]
+        if step is not None:
+            payload = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
+            return payload["state"], payload["meta"]
+        candidates = sorted(self.steps(), reverse=True)
+        first_exc: Exception | None = None
+        for i, s in enumerate(candidates):
+            try:
+                payload = self._mgr.restore(
+                    s, args=ocp.args.StandardRestore(abstract)
+                )
+            except Exception as e:  # orbax raises a zoo of types per damage mode
+                if first_exc is None:
+                    first_exc = e
+                if self._on_event is not None and i < len(candidates) - 1:
+                    self._on_event(
+                        "recovery", kind="checkpoint_fallback",
+                        bad_step=int(s), next_step=int(candidates[i + 1]),
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
+                continue
+            if validate is not None and not validate(payload["state"]):
+                if self._on_event is not None:
+                    self._on_event(
+                        "recovery", kind="skipped_nonfinite_checkpoint",
+                        step=int(s),
+                    )
+                continue
+            return payload["state"], payload["meta"]
+        if first_exc is not None:
+            raise first_exc  # nothing restored at all: systemic, be loud
+        return None
 
     def restore_best(self, template_state: Any):
         """Restore the keep-best snapshot; None when absent."""
@@ -195,7 +253,7 @@ class CheckpointManager:
             self._extra_mgr.close()
 
 
-def make_checkpoint_manager(session_config) -> CheckpointManager | None:
+def make_checkpoint_manager(session_config, on_event=None) -> CheckpointManager | None:
     """Build from ``session_config.checkpoint``; None when disabled
     (``every_n_iters`` <= 0)."""
     ck = session_config.checkpoint
@@ -205,4 +263,5 @@ def make_checkpoint_manager(session_config) -> CheckpointManager | None:
         session_config.folder,
         keep_last=ck.keep_last,
         keep_best=ck.keep_best,
+        on_event=on_event,
     )

@@ -139,6 +139,19 @@ BASE_SESSION_CONFIG = Config(
         transport="auto",
         pipeline_workers=True,
         worker_silence_s=120.0,
+        # SEED worker supervision: a dead worker respawns immediately the
+        # first time, then exponentially backed off (base * 2^k, capped) —
+        # a worker that dies AT STARTUP must not respawn-loop hot. The
+        # streak resets once a respawn survives its probation window; the
+        # current backoff is exported as the workers/respawn_backoff_s
+        # gauge.
+        respawn_backoff_s=0.5,
+        respawn_backoff_cap_s=30.0,
+        # inference server: sanitize nonfinite observation payloads
+        # (np.nan_to_num + a server/sanitized_requests gauge) instead of
+        # letting one corrupt slab slot poison the micro-batch, the acting
+        # policy, and every trajectory in flight
+        sanitize_obs=True,
         # host-env (gym/dm_control) loops: collect iteration k+1 on a
         # worker thread while the device learns on k (the reference's
         # learner never waited on actors — its prefetch thread kept
@@ -182,6 +195,40 @@ BASE_SESSION_CONFIG = Config(
         # the buffer itself)
         include_replay=False,
     ),
+    # fault-tolerant training (session/interrupt.py, launch/recovery.py):
+    recovery=Config(
+        # SIGTERM/SIGINT sentinel: latch the signal, stop at the next
+        # iteration boundary, write an emergency checkpoint — a TPU
+        # preemption costs at most one iteration instead of one
+        # checkpoint interval. Polled, never raced against orbax saves.
+        interrupt=True,
+        # divergence guard on the in-graph health/* signals, checked at
+        # the metrics cadence: 'rollback' restores the newest FINITE
+        # checkpoint (+ replay extra/ when snapshotted), re-seeds the
+        # offending batch, and applies bounded LR backoff; 'warn' only
+        # logs/emits (and still refuses to checkpoint poisoned state);
+        # 'off' disables detection. Multi-host drivers force 'warn'
+        # (rollback is a collective restore — relaunch with auto_resume
+        # instead).
+        on_divergence="rollback",
+        max_rollbacks=3,          # then TrainingDiverged — bounded, loud
+        lr_backoff=0.5,           # lr scale = lr_backoff ** rollback_count
+        min_lr_scale=0.05,        # ...floored here (bounded backoff)
+        grad_norm_limit=None,     # optional extra trip wire (None = NaN only)
+        # this many consecutive HEALTHY metrics windows clear the rollback
+        # streak: the budget targets a state that RE-diverges, not isolated
+        # transients spread over a production-length run (same reset rule
+        # as the SEED respawn backoff)
+        heal_after_windows=20,
+    ),
+    # deterministic chaos harness (utils/faults.py): a list of fault specs
+    # ({"site": ..., "kind": ..., "at": K, "times": N, ...}) injected at
+    # fixed call counts of named data-plane/trainer sites — worker kills,
+    # dropped/delayed frames, slab corruption, forced NaN state, SIGTERM
+    # mid-iteration. None = chaos off (and the registry is reset at every
+    # run start, so it can never leak between runs). CLI: --set
+    # 'session_config.faults.plan=[{"site":"trainer.iteration",...}]'.
+    faults=Config(plan=None),
     metrics=Config(
         every_n_iters=10,
         tensorboard=True,

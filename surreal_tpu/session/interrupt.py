@@ -1,0 +1,96 @@
+"""Preemption-safe shutdown sentinel (the robustness layer's first leg:
+a TPU preemption delivers SIGTERM with a short grace window, and the
+reference fleet treated actor/learner death as routine — SURVEY.md §5.3;
+RollArt-class systems checkpoint on the preemption signal rather than
+losing everything since the last periodic save).
+
+Design constraints, in order:
+
+- **No handler races with orbax async saves.** The signal handler does ONE
+  thing: latch a flag. All real work (the emergency checkpoint, session
+  close) happens at the next ITERATION BOUNDARY on the thread that owns
+  the checkpoint manager — a handler that called ``ckpt.save`` could fire
+  mid-``wait_until_finished`` and corrupt the very checkpoint a relaunch
+  needs.
+- **Second signal escalates.** A wedged run (e.g. a collective that will
+  never complete) must still be killable: the second SIGTERM/SIGINT raises
+  ``KeyboardInterrupt`` from the handler, unwinding through the drivers'
+  ``finally`` blocks (hooks/plane close) instead of waiting for a boundary
+  that may never come.
+- **Main-thread only, restore on close.** ``signal.signal`` is illegal off
+  the main thread; constructed there, the sentinel stays disabled (tests
+  that run drivers on worker threads keep working). ``close()`` restores
+  the previous handlers so nested/sequential sessions in one process
+  (tests, notebooks) do not leak handler state.
+
+Wiring: ``SessionHooks`` owns one sentinel per run and ORs ``fired`` into
+``end_iteration``'s stop flag, so every single-host driver exits its loop
+at the next boundary and writes its normal final checkpoint — which IS the
+emergency checkpoint, at most one iteration behind the preemption. The
+multi-host drivers ride the same path on rank 0; the stop is broadcast by
+the existing metrics-cadence agreement (``_maybe_agree_stop``), so the
+whole group leaves the collective schedule together — interrupt latency
+there is bounded by ``metrics.every_n_iters`` iterations. Ranks > 0
+install their own latch-only sentinel so a fleet-wide SIGTERM cannot kill
+them mid-collective while rank 0 still needs their participation.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+
+
+class InterruptSentinel:
+    """Latch SIGTERM/SIGINT into a flag polled at iteration boundaries."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, enabled: bool = True):
+        self._fired = threading.Event()
+        self.signum: int | None = None
+        self._count = 0
+        self._prev: dict[int, object] = {}
+        self.installed = False
+        if not enabled:
+            return
+        if threading.current_thread() is not threading.main_thread():
+            return  # signal.signal would raise; stay a disabled no-op
+        try:
+            for s in self.SIGNALS:
+                self._prev[s] = signal.signal(s, self._handle)
+            self.installed = True
+        except (ValueError, OSError):  # exotic embedding; stay disabled
+            self._prev.clear()
+
+    def _handle(self, signum, frame):
+        # async-signal context: latch and return — never touch locks,
+        # logging, or the checkpoint manager from here (module docstring)
+        self._count += 1
+        self.signum = signum
+        self._fired.set()
+        if self._count >= 2:
+            raise KeyboardInterrupt(
+                f"second interrupt (signal {signum}): forcing teardown"
+            )
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    def trigger(self, signum: int = signal.SIGTERM) -> None:
+        """In-process latch (tests / the chaos harness's non-signal path)."""
+        self.signum = signum
+        self._fired.set()
+
+    def close(self) -> None:
+        """Restore the previous handlers (idempotent)."""
+        if not self.installed:
+            return
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):  # off-main-thread close; leave as-is
+                pass
+        self._prev.clear()
+        self.installed = False

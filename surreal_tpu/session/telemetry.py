@@ -47,6 +47,18 @@ line, ``t`` = unix seconds):
                      SessionHooks.tune_event at build, the `surreal_tpu
                      tune` CLI with full candidate timings; diag reports
                      the last one plus hit/miss counts)
+    {"type": "recovery", "t": ..., "kind": "interrupt|tripped|rollback|
+     checkpoint_fallback|skipped_nonfinite_checkpoint|giveup", ...}
+                    (the fault-tolerance layer: preemption sentinel stops,
+                     divergence-guard trips/rollbacks with lr_scale and
+                     the restored step, damaged-checkpoint fallbacks —
+                     session/interrupt.py, launch/recovery.py,
+                     session/checkpoint.py)
+    {"type": "fault", "t": ..., "site": "...", "kind": "...", "call": N}
+                    (chaos-harness injections that actually fired,
+                     utils/faults.py — drained into the spine by
+                     SessionHooks so a chaos run documents what it
+                     survived)
 
 Heartbeats live per rank in ``telemetry/heartbeat_rank<k>.jsonl``:
 
@@ -278,6 +290,11 @@ def diag_summary(folder: str) -> dict | None:
     data_plane = None
     tune = None
     tune_hits = tune_misses = 0
+    recovery_counts: dict[str, int] = {}
+    recovery_last = None
+    fault_count = 0
+    fault_sites: dict[str, int] = {}
+    fault_last = None
     nonfinite_windows = 0
     t_first = t_last = None
     last_step = None
@@ -318,6 +335,19 @@ def diag_summary(folder: str) -> dict | None:
                 tune_hits += 1
             else:
                 tune_misses += 1
+        elif ev.get("type") == "recovery":
+            kind = str(ev.get("kind", "?"))
+            recovery_counts[kind] = recovery_counts.get(kind, 0) + 1
+            recovery_last = {
+                k: v for k, v in ev.items() if k not in ("type", "t")
+            }
+        elif ev.get("type") == "fault":
+            fault_count += 1
+            site = str(ev.get("site", "?"))
+            fault_sites[site] = fault_sites.get(site, 0) + 1
+            fault_last = {
+                k: v for k, v in ev.items() if k not in ("type", "t")
+            }
         elif ev.get("type") == "metrics":
             last_step = ev.get("step", last_step)
             vals = ev.get("values") or {}
@@ -359,6 +389,14 @@ def diag_summary(folder: str) -> dict | None:
         "tune": tune,
         "tune_hits": tune_hits,
         "tune_misses": tune_misses,
+        "recovery": (
+            {"counts": recovery_counts, "last": recovery_last}
+            if recovery_counts else None
+        ),
+        "faults": (
+            {"count": fault_count, "by_site": fault_sites, "last": fault_last}
+            if fault_count else None
+        ),
         "nonfinite_windows": nonfinite_windows,
         "heartbeats": heartbeats,
     }
@@ -446,6 +484,28 @@ def diag_report(folder: str) -> str | None:
                 )
             if len(trials) > 16:
                 lines.append(f"    ... {len(trials) - 16} more")
+    rec = s.get("recovery")
+    if rec is not None:
+        counts = ", ".join(
+            f"{k}={rec['counts'][k]}" for k in sorted(rec["counts"])
+        )
+        lines += ["", f"Recovery — {counts}"]
+        last = rec.get("last") or {}
+        if last:
+            lines.append(
+                "  last: "
+                + ", ".join(f"{k}={last[k]}" for k in sorted(last))
+            )
+    flt = s.get("faults")
+    if flt is not None:
+        sites = ", ".join(
+            f"{k}: {flt['by_site'][k]}" for k in sorted(flt["by_site"])
+        )
+        lines += [
+            "",
+            f"Faults injected (chaos harness) — {flt['count']} fired "
+            f"({sites})",
+        ]
     lines += ["", "Training health"]
     if s["health"]:
         lines.append(

@@ -128,3 +128,31 @@ def enable_compile_cache(cache_dir: str) -> bool:
         pass
     _install_cache_listener()
     return True
+
+
+def disable_compile_cache(restore_dir: str | None = None) -> None:
+    """Re-point (or disable, ``restore_dir=None``) the persistent compile
+    cache AND drop jax's latched cache object.
+
+    Restoring ``jax_compilation_cache_dir`` alone is NOT a clean undo on
+    this image's pin: the process keeps the Cache object latched at the
+    old directory, and that stale native state + a later orbax
+    restore-then-execute reproducibly SIGSEGVs the CPU backend (found by
+    ISSUE 5's kill-and-resume suite: the compile-cache plumb-through test
+    left the latch behind and every later same-process resume crashed).
+    Anything that re-points or turns off the cache mid-process — tests,
+    embedders, notebooks — must go through here; long-lived training
+    processes never need to (the cache is meant to stay live until exit).
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", restore_dir)
+    except (AttributeError, ValueError):
+        pass
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass

@@ -1,0 +1,225 @@
+"""Deterministic chaos harness: a config-driven fault-injection registry
+threaded through the data plane and the trainers, so every recovery path
+(worker respawn, dropped frames, slab corruption, divergence rollback,
+preemption shutdown) is exercised by tests instead of trusted.
+
+The reference Surreal system earned its robustness empirically — a fleet
+of flaky actors WAS the chaos harness (SURVEY.md §5.3). The TPU rebuild
+collapses those processes into one program, so faults must be injected
+deliberately. Systems operating RL at production scale treat component
+death and data-plane loss as routine (RollArt, arXiv:2512.22560; the
+in-network experience path, arXiv:2110.13506, assumes a lossy plane by
+construction); this module makes "routine" reproducible.
+
+Model: a *plan* is a list of fault specs, each
+
+    {"site": "<injection point>", "kind": "<fault>", "at": K, "times": N,
+     ...kind-specific args}
+
+``site`` names a fixed injection point in the code (below); ``at`` is the
+0-based index of the call to that site at which the fault fires (``times``
+consecutive calls, default 1). Scheduling is by CALL COUNT, not wall time,
+so a plan is deterministic for a deterministic program; where several
+threads share a site (a multi-worker fleet), *which* thread draws the
+k-th call is scheduling-dependent but *that* the k-th call faults is not
+— single-worker plans are exactly reproducible.
+
+Sites and the kinds they honor:
+
+    trainer.iteration    every driver loop, once per iteration
+                         (``sigterm``: deliver SIGTERM to this process
+                         mid-iteration; ``nan_state``: poison the train
+                         state with NaN — the forced-NaN-gradient
+                         injection; ``delay``: sleep ``ms``)
+    env_worker.step      once per env-worker step loop pass
+                         (``kill_worker``: raise FaultInjected — the
+                         supervisor must respawn; ``delay``: sleep ``ms``)
+    transport.send       every worker->server frame, both transports
+                         (``drop_frame``: swallow the frame;
+                         ``delay_frame``: sleep ``ms`` first;
+                         ``corrupt_slab``: overwrite the outgoing obs
+                         payload/slab slot with NaN/garbage)
+    server.serve         every inference-server micro-batch forward
+                         (``delay``: sleep ``ms`` in the serve thread)
+    param_service.reply  every parameter-server REP reply
+                         (``delay_reply``: sleep ``ms`` before replying —
+                         drives client timeouts; REQ/REP forbids a true
+                         drop, the REP socket must answer to recover)
+
+Config wiring: ``session_config.faults.plan`` (a list of spec dicts, or a
+JSON string of one for ``--set`` CLI overrides). Drivers call
+``configure_from`` at run start — which also RESETS the registry, so an
+unconfigured run is guaranteed fault-free. Thread-mode SEED workers share
+this process's registry; process-mode workers receive the plan through
+their spawn kwargs — on each index's FIRST spawn only (a respawned
+process restarts call counters at zero, so re-sending the plan would
+re-fire one-shot faults forever) — and configure their own (their
+firings are then only visible in their own process). Every firing is
+recorded;
+``SessionHooks`` drains the record into ``fault`` telemetry events so
+``surreal_tpu diag`` can show exactly which faults a session survived.
+
+The inactive path costs one attribute check per site call — safe to leave
+compiled into production binaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any
+
+SITES = frozenset(
+    {
+        "trainer.iteration",
+        "env_worker.step",
+        "transport.send",
+        "server.serve",
+        "param_service.reply",
+    }
+)
+
+
+class FaultInjected(RuntimeError):
+    """Raised by kill-type injections; supervised components must treat it
+    exactly like any organic crash (respawn, re-raise, or record)."""
+
+
+class FaultInjector:
+    """One registry of scheduled faults. Thread-safe: data-plane sites fire
+    from worker/server threads concurrently with the trainer's."""
+
+    def __init__(self, plan: list[dict] | None = None):
+        self.plan: list[dict] = []
+        for entry in plan or []:
+            entry = dict(entry)
+            site = entry.get("site")
+            if site not in SITES:
+                raise ValueError(
+                    f"fault site {site!r} unknown; sites: {sorted(SITES)}"
+                )
+            if "kind" not in entry:
+                raise ValueError(f"fault spec {entry!r} has no 'kind'")
+            entry["at"] = int(entry.get("at", 0))
+            entry["times"] = int(entry.get("times", 1))
+            self.plan.append(entry)
+        self._counts: dict[str, int] = {}
+        self._fired: list[dict] = []
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(self.plan)
+
+    def fire(self, site: str) -> dict | None:
+        """Count one pass through ``site``; return the spec scheduled for
+        this call, or None (the overwhelmingly common case)."""
+        if not self.plan:
+            return None
+        with self._lock:
+            n = self._counts.get(site, 0)
+            self._counts[site] = n + 1
+            for f in self.plan:
+                if f["site"] == site and f["at"] <= n < f["at"] + f["times"]:
+                    self._fired.append(
+                        {"site": site, "kind": f["kind"], "call": n}
+                    )
+                    return f
+        return None
+
+    def drain_fired(self) -> list[dict]:
+        """Hand out (and clear) the record of fired faults — the telemetry
+        mirror's feed."""
+        with self._lock:
+            out, self._fired = self._fired, []
+        return out
+
+
+_injector = FaultInjector()
+
+
+def get() -> FaultInjector:
+    return _injector
+
+
+def configure(plan: list[dict] | None) -> FaultInjector:
+    """Install a fresh registry (None/[] = chaos off). Replaces counts and
+    the fired record — one configure per run."""
+    global _injector
+    _injector = FaultInjector(plan)
+    return _injector
+
+
+def configure_from(session_config) -> FaultInjector:
+    """Read ``session_config.faults.plan`` (list, or JSON string for CLI
+    ``--set``) and install it. Called at run start by every single-host
+    driver; a config without the knob RESETS the registry."""
+    fc = session_config.get("faults", None)
+    plan = fc.get("plan", None) if fc is not None else None
+    if isinstance(plan, str):
+        plan = json.loads(plan)
+    return configure(plan)
+
+
+def fire(site: str) -> dict | None:
+    return _injector.fire(site)
+
+
+def drain_fired() -> list[dict]:
+    return _injector.drain_fired()
+
+
+# -- site helpers -------------------------------------------------------------
+
+def sleep_ms(spec: dict) -> None:
+    time.sleep(float(spec.get("ms", 10.0)) / 1e3)
+
+
+def corrupt_array(arr):
+    """Overwrite a payload array in place with NaN (floating) or the dtype
+    max (integral) — the 'corrupt a slab slot' injection. Returns arr."""
+    import numpy as np
+
+    if np.issubdtype(arr.dtype, np.floating):
+        arr[...] = np.nan
+    elif np.issubdtype(arr.dtype, np.integer):
+        arr[...] = np.iinfo(arr.dtype).max
+    else:  # bool payloads: flip everything
+        arr[...] = True
+    return arr
+
+
+def poison_state(state: Any) -> Any:
+    """Return ``state`` with its first floating leaf replaced by NaN — the
+    forced-NaN-gradient injection: the next learn's grads, params, and the
+    in-graph ``health/nonfinite`` guard all go nonfinite, which is exactly
+    the condition the divergence-rollback policy must recover from."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(state)
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact):
+            leaves[i] = jnp.full_like(leaf, jnp.nan)
+            break
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def apply_trainer_fault(spec: dict, state: Any) -> Any:
+    """Interpret a ``trainer.iteration`` firing; returns the (possibly
+    poisoned) state."""
+    kind = spec["kind"]
+    if kind == "sigterm":
+        # mid-iteration preemption: the sentinel's handler latches it and
+        # the driver stops at the NEXT boundary with an emergency save
+        os.kill(os.getpid(), signal.SIGTERM)
+        return state
+    if kind == "nan_state":
+        return poison_state(state)
+    if kind == "delay":
+        sleep_ms(spec)
+        return state
+    raise ValueError(f"trainer.iteration cannot apply fault kind {kind!r}")

@@ -192,7 +192,14 @@ def test_compile_cache_knob_plumbs_through(tmp_path):
         assert cc["misses"] >= 1
         assert "Compile cache" in diag_report(str(folder))
     finally:
-        jax.config.update("jax_compilation_cache_dir", old_dir)
+        # restoring the dir alone leaves jax's latched Cache object behind,
+        # and that stale native state + a later same-process orbax
+        # restore-then-execute SIGSEGVs (utils/compat.py::
+        # disable_compile_cache) — tests/test_recovery.py's kill-and-resume
+        # suite found it the hard way
+        from surreal_tpu.utils.compat import disable_compile_cache
+
+        disable_compile_cache(restore_dir=old_dir)
 
 
 def test_compile_cache_knob_absent_or_none_is_off(tmp_path):

@@ -1,0 +1,310 @@
+"""Chaos harness (utils/faults.py): the deterministic injection registry
+itself, and each data-plane recovery path it exercises — worker kill with
+respawn backoff, dropped frames, corrupt slab slots, delayed parameter-
+server replies against the bounded-retry client."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from surreal_tpu.distributed import (
+    InferenceServer,
+    ParameterClient,
+    ParameterPublisher,
+    ParameterServer,
+    run_env_worker,
+)
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import BASE_ENV_CONFIG, base_config
+from surreal_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    faults.configure(None)  # never leak a plan into the next test
+
+
+# -- the registry -------------------------------------------------------------
+
+def test_injector_schedule_is_by_call_count():
+    inj = faults.configure([
+        {"site": "env_worker.step", "kind": "kill_worker", "at": 2},
+        {"site": "transport.send", "kind": "drop_frame", "at": 1, "times": 2},
+    ])
+    assert inj.active
+    # env_worker.step: fires exactly on call index 2
+    hits = [faults.fire("env_worker.step") for _ in range(5)]
+    assert [h["kind"] if h else None for h in hits] == [
+        None, None, "kill_worker", None, None,
+    ]
+    # transport.send: `times` consecutive calls starting at `at`
+    hits = [faults.fire("transport.send") for _ in range(4)]
+    assert [h["kind"] if h else None for h in hits] == [
+        None, "drop_frame", "drop_frame", None,
+    ]
+    fired = inj.drain_fired()
+    assert [(f["site"], f["call"]) for f in fired] == [
+        ("env_worker.step", 2), ("transport.send", 1), ("transport.send", 2),
+    ]
+    assert inj.drain_fired() == []  # drained
+
+
+def test_injector_validates_sites_and_reset():
+    with pytest.raises(ValueError):
+        faults.configure([{"site": "nonsense.site", "kind": "kill_worker"}])
+    with pytest.raises(ValueError):
+        faults.configure([{"site": "env_worker.step"}])  # no kind
+    faults.configure([{"site": "env_worker.step", "kind": "delay", "at": 0}])
+    assert faults.fire("env_worker.step") is not None
+    faults.configure(None)
+    assert not faults.get().active
+    assert faults.fire("env_worker.step") is None
+
+
+def test_configure_from_accepts_json_string():
+    cfg = Config(faults=Config(
+        plan='[{"site": "server.serve", "kind": "delay", "at": 0, "ms": 1}]'
+    ))
+    inj = faults.configure_from(cfg)
+    assert inj.plan[0]["site"] == "server.serve"
+    # a config WITHOUT the knob resets the registry
+    assert not faults.configure_from(Config()).active
+
+
+def test_poison_state_hits_first_inexact_leaf_only():
+    import jax.numpy as jnp
+
+    state = {"step": jnp.array(3), "w": jnp.ones((2, 2)), "b": jnp.zeros(3)}
+    out = faults.poison_state(state)
+    assert int(out["step"]) == 3
+    poisoned = [k for k in ("w", "b") if not bool(jnp.isfinite(out[k]).all())]
+    assert len(poisoned) == 1
+
+
+# -- SEED plane: worker kill -> respawn with exponential backoff --------------
+
+def _seed_cfg(folder, total, plan, ckpt_every=0, **topo):
+    return Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder=str(folder),
+            total_env_steps=total,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=ckpt_every),
+            eval=Config(every_n_iters=0),
+            topology=Config(num_env_workers=1, **topo),
+            faults=Config(plan=plan),
+        ),
+    ).extend(base_config())
+
+
+def test_seed_worker_kill_chaos_respawns_and_reports_backoff(tmp_path):
+    """`kill_worker` at step K: the sole worker dies mid-run, the
+    supervisor respawns it under the backoff schedule, and the run makes
+    its full budget — with the respawn + backoff gauges in the metrics."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    trainer = SEEDTrainer(_seed_cfg(
+        tmp_path, 800,
+        plan=[{"site": "env_worker.step", "kind": "kill_worker", "at": 25}],
+    ))
+    state, metrics = trainer.run()
+    assert metrics["time/env_steps"] >= 800
+    assert metrics["workers/respawns"] >= 1.0
+    # first respawn arms the base backoff for any follow-up death
+    assert metrics["workers/respawn_backoff_s"] == pytest.approx(0.5)
+
+
+def test_respawn_backoff_defers_hot_loop():
+    """Unit: a worker that dies instantly must not respawn-loop hot — the
+    supervisor spaces respawns base * 2^k up to the cap."""
+    from surreal_tpu.launch.seed_trainer import _DataPlane
+
+    class _Dead:
+        def is_alive(self):
+            return False
+
+    class _Server:
+        address = "inproc://stub"
+
+    class _Stub:
+        spawns = 0
+
+        def _spawn_one(self, i, env_cfg, address, stop):
+            self.spawns += 1
+            return _Dead()
+
+    stub = _Stub()
+    plane = _DataPlane(
+        stub, _Server(), [_Dead()], None, threading.Event(), 1.0,
+        respawn_backoff_s=0.05, respawn_backoff_cap_s=0.2,
+    )
+    plane.supervise()
+    assert stub.spawns == 1 and plane.respawn_backoff_s == pytest.approx(0.05)
+    plane.supervise()  # inside the backoff window: deferred
+    assert stub.spawns == 1
+    time.sleep(0.06)
+    plane.supervise()  # window elapsed: respawn, backoff doubles
+    assert stub.spawns == 2 and plane.respawn_backoff_s == pytest.approx(0.1)
+    time.sleep(0.11)
+    plane.supervise()
+    assert stub.spawns == 3 and plane.respawn_backoff_s == pytest.approx(0.2)
+    time.sleep(0.21)
+    plane.supervise()  # capped, not 0.4
+    assert stub.spawns == 4 and plane.respawn_backoff_s == pytest.approx(0.2)
+
+
+def test_seed_dropped_frame_recovers_via_respawn(tmp_path):
+    """`drop_frame`: one worker request frame is swallowed on the wire;
+    the worker's reply wait runs out its (shortened) silence budget, it
+    dies like a real network fault, and the supervisor-respawned worker
+    finishes the budget. Pipelining is off: a two-slot worker survives a
+    single dropped frame at degraded capacity (the other slot keeps its
+    round trips flowing) — here we want the full death-and-respawn path."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    trainer = SEEDTrainer(_seed_cfg(
+        tmp_path, 600,
+        plan=[{"site": "transport.send", "kind": "drop_frame", "at": 30}],
+        worker_silence_s=2.0,
+        respawn_backoff_s=0.05,
+        pipeline_workers=False,
+    ))
+    state, metrics = trainer.run()
+    assert metrics["time/env_steps"] >= 600
+    assert metrics["workers/respawns"] >= 1.0
+
+
+def test_seed_nan_state_rolls_back_and_keeps_serving(tmp_path):
+    """Forced-NaN state on the SEED path: the guard trips at the metrics
+    cadence, the trainer restores the last finite checkpoint, re-arms the
+    inference server's act closure from it, and the data plane keeps
+    producing — the run finishes its budget with finite health."""
+    import json
+    import os
+
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    trainer = SEEDTrainer(_seed_cfg(
+        tmp_path, 900,
+        plan=[{"site": "trainer.iteration", "kind": "nan_state", "at": 3}],
+        ckpt_every=2,
+    ))
+    state, metrics = trainer.run()
+    assert metrics["time/env_steps"] >= 900
+    assert metrics["health/nonfinite"] == 0.0
+    events = []
+    with open(os.path.join(str(tmp_path), "telemetry", "events.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                events.append(json.loads(line))
+    kinds = [e.get("kind") for e in events if e.get("type") == "recovery"]
+    assert "tripped" in kinds and "rollback" in kinds
+
+
+# -- corrupt slab slot -> server-side sanitize --------------------------------
+
+def _det_act_fn(n_actions=2):
+    def act_fn(obs):
+        b = obs.shape[0]
+        flat = obs.reshape(b, -1).astype(np.float64)
+        actions = (np.nan_to_num(flat).sum(axis=1) > 0).astype(np.int64) % n_actions
+        logp = np.full(b, -np.log(n_actions), np.float32)
+        return actions, {"logp": logp}
+
+    return act_fn
+
+
+@pytest.mark.parametrize("transport", ["shm", "pickle"])
+def test_corrupt_slab_slot_is_sanitized_not_propagated(transport, tmp_path):
+    """`corrupt_slab`: NaN-stomp an outgoing obs payload (the slab slot
+    under shm; the payload copy under the pickle fallback). The server
+    sanitizes + counts instead of letting one slot poison the micro-batch
+    — every trajectory chunk it assembles stays finite."""
+    faults.configure([
+        {"site": "transport.send", "kind": "corrupt_slab", "at": 10, "times": 2},
+    ])
+    server = InferenceServer(
+        act_fn=_det_act_fn(), unroll_length=8, transport="auto",
+    )
+    env_cfg = Config(name="gym:CartPole-v1", num_envs=3).extend(BASE_ENV_CONFIG)
+    stop = threading.Event()
+    w = threading.Thread(
+        target=run_env_worker,
+        args=(env_cfg, server.address, 0),
+        kwargs={"stop_event": stop, "max_steps": 240, "transport": transport},
+        daemon=True,
+    )
+    chunks = []
+    try:
+        w.start()
+        w.join(timeout=60)
+        assert not w.is_alive()
+        time.sleep(0.3)
+        while not server.chunks.empty():
+            chunks.append(server.chunks.get_nowait())
+        assert server.sanitized_requests >= 1
+        assert server.queue_stats()["server/sanitized_requests"] >= 1.0
+        assert chunks, "no trajectory chunks assembled"
+        for c in chunks:
+            assert np.isfinite(c["obs"]).all()
+            assert np.isfinite(c["next_obs"]).all()
+    finally:
+        stop.set()
+        server.close()
+
+
+# -- parameter service: delayed replies vs the bounded-retry client -----------
+
+def test_param_client_bounded_retry_survives_one_delayed_reply():
+    faults.configure([
+        {"site": "param_service.reply", "kind": "delay_reply", "at": 0,
+         "ms": 800},
+    ])
+    import jax.numpy as jnp
+
+    pub = ParameterPublisher()
+    server = ParameterServer(pub.address)
+    client = ParameterClient(server.address, template={"w": jnp.zeros(3)})
+    try:
+        pub.publish({"w": jnp.full((3,), 7.0)})
+        deadline = time.time() + 5
+        got = None
+        while got is None and time.time() < deadline:
+            # first reply stalls 800ms > the 200ms timeout; the bounded
+            # retry recovers the REQ socket and the next attempt lands
+            got = client.fetch(timeout_ms=200, retries=3, backoff_s=0.05)
+        assert got is not None
+        np.testing.assert_allclose(np.asarray(got["w"]), 7.0)
+    finally:
+        client.close()
+        server.close()
+        pub.close()
+
+
+def test_param_client_retry_budget_is_bounded():
+    """Against a peer that stays silent, fetch raises after its bounded
+    attempts instead of blocking forever."""
+    faults.configure([
+        {"site": "param_service.reply", "kind": "delay_reply", "at": 0,
+         "times": 10_000, "ms": 2000},
+    ])
+    import jax.numpy as jnp
+
+    pub = ParameterPublisher()
+    server = ParameterServer(pub.address)
+    client = ParameterClient(server.address, template={"w": jnp.zeros(3)})
+    try:
+        pub.publish({"w": jnp.zeros(3)})
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            client.fetch(timeout_ms=100, retries=1, backoff_s=0.05)
+        assert time.monotonic() - t0 < 5.0  # two attempts + one backoff
+    finally:
+        client.close()
+        server.close()
+        pub.close()

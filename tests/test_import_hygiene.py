@@ -240,6 +240,39 @@ def test_data_plane_pickles_only_in_fallback_codec():
     )
 
 
+_SUPERVISED_PACKAGES = ("distributed", "launch")
+
+
+def test_no_swallowed_exceptions_in_supervised_code():
+    """Robustness lint (ISSUE 5 satellite): a blanket ``except Exception:
+    pass`` in the distributed/launch layers silently eats exactly the
+    failures the recovery layer exists to handle — a worker thread that
+    swallows its crash looks alive to the supervisor and is never
+    respawned. Supervised code must re-raise, degrade explicitly through
+    a NARROW exception list with the reason commented, or record a
+    telemetry event. (Narrow excepts like ``except OSError: pass`` on
+    best-effort cleanup paths stay legal — this bans only the blanket
+    form.)"""
+    import re
+
+    swallow = re.compile(
+        r"except\s+(?:BaseException|Exception)(?:\s+as\s+\w+)?\s*:"
+        r"\s*(?:#[^\n]*)?\n\s+pass\b"
+    )
+    bad = []
+    for pkg in _SUPERVISED_PACKAGES:
+        for path in sorted((_PKG_ROOT / pkg).rglob("*.py")):
+            src = path.read_text()
+            for m in swallow.finditer(src):
+                line = src.count("\n", 0, m.start()) + 1
+                bad.append(f"{path.relative_to(_REPO_ROOT)}:{line}")
+    assert not bad, (
+        "blanket except-and-pass in supervised distributed/launch code "
+        "(re-raise, narrow the exception list with a comment, or record "
+        "a telemetry event):\n" + "\n".join(bad)
+    )
+
+
 def test_graft_entry_import_initializes_no_backend():
     """__graft_entry__ itself must also be import-clean: the driver imports
     it before calling dryrun_multichip, which is where platform selection
