@@ -1,0 +1,149 @@
+"""Perf regression gate (ISSUE 6 satellite): compare the newest committed
+``BENCH_*.json`` row against the previous committed baseline with the
+same workload fingerprint and exit nonzero on a >10% throughput
+regression.
+
+Fingerprint = the artifact's ``metric`` string plus the recorded
+platform/device (a CPU-fallback row must never gate against a chip
+record, and vice versa — bench.py records both fields since PR 2; older
+artifacts recorded neither, which this gate treats as a distinct
+"unrecorded" fingerprint rather than guessing).
+
+Tolerances (CI must stay green through environment noise, red only on a
+real regression):
+
+- no artifacts at all, only one artifact per fingerprint, or a newest
+  artifact from a FAILED round (``parsed: null`` — the round-5 backend
+  outage shape): rc 0 with a note. A missing measurement is a campaign
+  problem, not a regression.
+- improvement or regression within ``--threshold`` (default 10%): rc 0.
+- newest value < (1 - threshold) x baseline value for the same
+  fingerprint: rc 1, with both rows printed.
+
+Usage:
+    python perf_gate.py                  # gate the repo's committed rows
+    python perf_gate.py --threshold 0.2 --dir /path/to/artifacts
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def load_rows(art_dir: str) -> list[dict]:
+    """All parseable ``BENCH_r*.json`` rows, oldest -> newest by round
+    number — the ONE parser for the committed headline-artifact trail
+    (this gate AND perf_report.py's observability table import it, so
+    the CI gate and PERF.md can never classify the same artifact
+    differently).
+
+    Each row: {file, round, metric, value, platform, device, mfu,
+    failed}. Files without a numeric round suffix (BENCH_host.json,
+    BENCH_tune.json) carry workload tables, not one gated headline row —
+    skipped entirely."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(art_dir, "BENCH_r*.json"))):
+        name = os.path.basename(path)
+        m = re.match(r"BENCH_r(\d+)\.json$", name)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        # driver artifacts wrap the bench line under "parsed"; a failed
+        # round writes "parsed": null — `or` lets it fall through to the
+        # raw dict shape (standalone bench.py output)
+        parsed = data.get("parsed") or data
+        if (
+            not isinstance(parsed, dict)
+            or parsed.get("value") is None
+        ):
+            rows.append({"file": name, "round": int(m.group(1)),
+                         "failed": True})
+            continue
+        rows.append({
+            "file": name,
+            "round": int(m.group(1)),
+            "metric": str(parsed.get("metric")),
+            "value": float(parsed["value"]),
+            "platform": parsed.get("platform"),
+            "device": parsed.get("device"),
+            "mfu": parsed.get("mfu"),
+            "failed": False,
+        })
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def fingerprint(row: dict) -> tuple:
+    return (
+        row.get("metric"),
+        row.get("platform") or "unrecorded",
+        row.get("device") or "unrecorded",
+    )
+
+
+def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
+    rows = load_rows(art_dir)
+    valid = [r for r in rows if not r.get("failed")]
+    if not rows:
+        print("perf_gate: no BENCH_*.json artifacts found — nothing to "
+              "gate (rc 0)", file=out)
+        return 0
+    newest = rows[-1]
+    if newest.get("failed"):
+        print(
+            f"perf_gate: newest artifact {newest['file']} is from a FAILED "
+            "round (no parsed row) — a missing measurement is a campaign "
+            "problem, not a regression (rc 0)", file=out,
+        )
+        return 0
+    baseline = None
+    for r in valid[:-1][::-1]:
+        if fingerprint(r) == fingerprint(newest):
+            baseline = r
+            break
+    if baseline is None:
+        print(
+            f"perf_gate: {newest['file']} ({newest['metric']}) has no "
+            "earlier committed artifact with the same fingerprint — "
+            "nothing to compare (rc 0)", file=out,
+        )
+        return 0
+    ratio = newest["value"] / baseline["value"] if baseline["value"] else 1.0
+    verdict = (
+        f"perf_gate: {newest['file']} {newest['value']:,.1f} vs baseline "
+        f"{baseline['file']} {baseline['value']:,.1f} "
+        f"({newest['metric']}; ratio {ratio:.3f}, threshold "
+        f"{1.0 - threshold:.2f})"
+    )
+    if ratio < 1.0 - threshold:
+        print(verdict + " — REGRESSION", file=out)
+        return 1
+    print(verdict + " — ok", file=out)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="gate the newest BENCH_*.json against the committed "
+                    "baseline for the same workload fingerprint"
+    )
+    ap.add_argument("--dir", default=".", help="artifact directory")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="allowed fractional regression (default 0.10)")
+    args = ap.parse_args(argv)
+    return gate(args.dir, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1022,6 +1022,60 @@ def _autotuner_lines() -> list[str]:
     return lines
 
 
+def _perf_observability_lines() -> list[str]:
+    """The 'Performance observability' PERF.md section: static mechanism
+    text plus an MFU-per-committed-BENCH-artifact table, so regeneration
+    keeps the observability story and the measured MFU trail together.
+    One function so ``main()`` and the committed PERF.md cannot drift."""
+    lines = [
+        "",
+        "## Performance observability (in-graph cost/MFU accounting, "
+        "trace correlation, on-demand profiling)",
+        "",
+        "The measurement layer under every number above "
+        "(`session/costs.py`, `session/profile.py`, telemetry spine "
+        "extensions): each driver registers its jitted hot programs with "
+        "XLA's cost model at startup (per-program FLOPs / bytes accessed "
+        "/ arithmetic intensity as `program_cost` telemetry events) and "
+        "emits live `perf/mfu` + `perf/membw_util` gauges at the metrics "
+        "cadence — pure host arithmetic over already-recorded phase "
+        "windows, transfer-guard proven to add zero device->host syncs. "
+        "The SEED data plane stamps a run-scoped trace id plus span ids "
+        "into its control frames so `surreal_tpu diag` stitches a "
+        "cross-process timeline (worker step -> frame in flight -> serve "
+        "batch -> queue dwell -> learn) with p50/p90/p99 per hop, and "
+        "`surreal_tpu profile <folder>` captures an on-demand "
+        "`jax.profiler` window into `<folder>/telemetry/profiles/`. "
+        "`perf_gate.py` turns the committed artifact trail below into a "
+        "CI gate (>10% regression on the same workload fingerprint "
+        "exits nonzero).",
+        "",
+        "MFU per committed BENCH artifact (XLA cost model / "
+        f"{PEAK_FLOPS_BF16 / 1e12:.0f} TFLOP/s bf16 peak; 'n/a' predates "
+        "the cost accounting or is a failed round):",
+        "",
+        "| Artifact | metric | env steps/s | MFU |",
+        "|---|---|---|---|",
+    ]
+    # one artifact parser for the gate and this table (perf_gate.py):
+    # the CI gate and PERF.md must never classify the same row differently
+    from perf_gate import load_rows
+
+    for row in load_rows("."):
+        if row.get("failed"):
+            lines.append(f"| `{row['file']}` | (failed round) | n/a | n/a |")
+            continue
+        mfu = row.get("mfu")
+        lines.append(
+            "| `{p}` | {m} | {v:,.0f} | {mfu} |".format(
+                p=row["file"], m=row.get("metric", "?"),
+                v=row["value"],
+                mfu=f"{float(mfu) * 100:.3f}%" if mfu is not None else "n/a",
+            )
+        )
+    return lines
+
+
 def _load_block_vs_row():
     """Load perf_curves.py's artifact if present — the comparison is a
     slow chip-bound campaign run separately; keeping it as a JSON artifact
@@ -1342,6 +1396,10 @@ def main(argv=None) -> None:
     # unconditionally; the measured table rides the BENCH_tune.json
     # artifact so a regen without the search keeps the last measured run
     lines += _autotuner_lines()
+    # static section + artifact table: the observability layer is
+    # documented unconditionally; the MFU trail rides the committed
+    # BENCH_r*.json artifacts
+    lines += _perf_observability_lines()
     host = next((r for r in rows if r.get("host_attrib")), None)
     if host:
         ha = host["host_attrib"]

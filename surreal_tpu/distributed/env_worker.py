@@ -63,6 +63,7 @@ def run_env_worker(
     pipeline: bool = False,
     server_silence_s: float = 120.0,
     fault_plan: list | None = None,
+    trace_id: str | None = None,
 ) -> int:
     """Step envs against the inference server until ``max_steps`` or
     ``stop_event``. Returns total env steps executed.
@@ -78,6 +79,11 @@ def run_env_worker(
     ``fault_plan``: chaos-harness plan for SPAWNED workers (their process
     starts with an empty registry; thread workers share the trainer's and
     must NOT pass one — reconfiguring would reset the shared counters).
+    ``trace_id``: the session's run-scoped trace id (SessionHooks mints
+    it; spawn kwargs forward it) — carried in the shm hello / the pickle
+    priming message, and every STEP frame stamps a per-worker span
+    sequence + send timestamp so the server can measure the
+    frame-in-flight hop and diag can stitch the cross-process timeline.
     """
     from surreal_tpu.envs import make_env
     from surreal_tpu.session.config import Config
@@ -125,12 +131,19 @@ def run_env_worker(
             )
         tr = dp.negotiate_worker_transport(
             sock, transport, widths, envs[0].specs, server_address,
-            stop_event, timeout_s=server_silence_s,
+            stop_event, timeout_s=server_silence_s, trace=trace_id,
         )
         if tr is None:
             return 0  # stop requested mid-handshake
 
         steps = 0
+        span = 0                # per-worker span sequence (trace stitching)
+        # the server derives the frame-in-flight hop as recv - t_send,
+        # which is only meaningful on a SHARED clock: a remote worker's
+        # wall clock can skew by more than the hop itself, so only
+        # same-host workers stamp t_send (0.0 = "don't measure me", the
+        # server skips it)
+        stamp_clock = dp.local_address(server_address)
         act_latency_ms = None   # EWMA of the server round trip (telemetry)
         occupancy = 0.0         # EWMA: env-step time / (step + reply wait)
         sent_at = [0.0] * n_slots
@@ -139,8 +152,14 @@ def run_env_worker(
         # (or wait on) one sub-slice the other's round trip is in flight
         for s in range(n_slots):
             # first reset seeds from the slot config (adapters fall back
-            # to their construction seed when none is passed)
-            tr.send(s, {"obs": envs[s].reset()})
+            # to their construction seed when none is passed). The pickle
+            # transport has no hello handshake, so the priming message
+            # carries the inherited trace id instead.
+            span += 1
+            tr.send(s, {
+                "obs": envs[s].reset(), "trace": trace_id,
+                "span": span, "t_send": time.time() if stamp_clock else 0.0,
+            })
             sent_at[s] = time.monotonic()
         steady = False
         while not (stop_event is not None and stop_event.is_set()):
@@ -171,6 +190,7 @@ def run_env_worker(
             step_s = time.monotonic() - now
             occupancy = 0.1 * (step_s / max(step_s + wait_s, 1e-9)) + 0.9 * occupancy
             steps += envs[slot].num_envs
+            span += 1
             msg = {
                 "obs": out.obs,
                 "reward": out.reward,
@@ -184,6 +204,11 @@ def run_env_worker(
                 # 'server/pipeline_occupancy')
                 "act_latency_ms": act_latency_ms,
                 "pipeline_occupancy": occupancy,
+                # span sequence + send stamp: the server measures the
+                # frame-in-flight hop as recv - t_send (same-host workers
+                # only — see stamp_clock above)
+                "span": span,
+                "t_send": time.time() if stamp_clock else 0.0,
             }
             if out.done.any():
                 # only meaningful (and only shipped — an obs-sized copy

@@ -52,7 +52,8 @@ class _WorkerTrack:
 class _WorkerState:
     """Per-identity transport state: negotiated slab + liveness stamp."""
 
-    __slots__ = ("slab", "spec", "views", "last_seen", "occupancy")
+    __slots__ = ("slab", "spec", "views", "last_seen", "occupancy",
+                 "trace_id", "last_span")
 
     def __init__(self):
         self.slab = None                    # SharedMemory (server-owned)
@@ -60,6 +61,9 @@ class _WorkerState:
         self.views: list[dict] = []
         self.last_seen = time.monotonic()
         self.occupancy: float | None = None  # worker-reported pipeline gauge
+        self.trace_id: str | None = None     # inherited run trace (hello /
+        #                                      pickle priming message)
+        self.last_span = 0                   # newest span seq seen
 
 
 # a worker silent this long no longer counts toward the auto-tuned
@@ -97,7 +101,12 @@ class InferenceServer:
         transport: str = "auto",
         auto_tune: bool = False,
         sanitize_obs: bool = True,
+        trace_id: str | None = None,
     ):
+        # the run-scoped trace id this server belongs to (SessionHooks
+        # mints it; the SEED trainer forwards it) — lets worker_traces()
+        # consumers cross-check a frame's fleet against THIS run
+        self.trace_id = trace_id
         self._act_fn = act_fn
         self._act_lock = threading.Lock()
         self._version = 0  # params version; bumped by every set_act_fn
@@ -130,6 +139,12 @@ class InferenceServer:
         # server thread; GIL-atomic float reads from the trainer.
         self._serve_ms_ewma: float | None = None
         self._serve_batch_ewma: float | None = None
+        # per-hop latency sample windows for the cross-process timeline
+        # (ISSUE 6): frame-in-flight (worker send stamp -> server recv,
+        # same-host clocks) and per-serve-batch duration. Appended only by
+        # the server thread; hop_stats() snapshots under the GIL.
+        self._hop_transit: "deque[float]" = deque(maxlen=512)
+        self._hop_serve: "deque[float]" = deque(maxlen=512)
         # wire accounting: control/payload bytes in+out and env steps
         # served — the bytes/step gauge is the zero-copy transport's
         # success metric (pickle ships the arrays; shm ships ~30 B frames)
@@ -208,10 +223,14 @@ class InferenceServer:
                     else:  # 'msg' — the pickle fallback dict
                         msg = obj
                         st = self._states.get(ident)
-                        if st is not None:
-                            st.last_seen = time.monotonic()
-                        else:
-                            self._states[ident] = _WorkerState()
+                        if st is None:
+                            st = self._states[ident] = _WorkerState()
+                        st.last_seen = time.monotonic()
+                        # pickle transport has no hello: the priming
+                        # message carries the inherited run trace id
+                        if msg.get("trace"):
+                            st.trace_id = msg["trace"]
+                    self._note_hop(ident, msg)
                     if not pending:
                         deadline = time.monotonic() + self.max_wait_ms / 1000
                     pending.append((ident, msg))
@@ -244,6 +263,19 @@ class InferenceServer:
         if self._serve_ms_ewma is not None:
             self.max_wait_ms = min(20.0, max(1.0, 0.25 * self._serve_ms_ewma))
 
+    def _note_hop(self, ident: bytes, msg: dict) -> None:
+        """Record the frame-in-flight hop + span bookkeeping for one
+        request (server thread only). ``t_send`` is the worker's unix
+        send stamp — same host, shared clock; negative skew clamps to 0."""
+        t_send = msg.get("t_send")
+        if isinstance(t_send, (int, float)) and t_send > 0:
+            self._hop_transit.append(max(0.0, (time.time() - t_send) * 1e3))
+        span = msg.get("span")
+        if span:
+            st = self._states.get(ident)
+            if st is not None:
+                st.last_span = int(span)
+
     def _handle_hello(self, ident: bytes, info: dict) -> None:
         """Negotiate (or re-negotiate) the shm slab for one identity.
 
@@ -253,6 +285,8 @@ class InferenceServer:
         ownership, so a SIGKILLed worker can never leak ``/dev/shm``."""
         st = self._states.setdefault(ident, _WorkerState())
         st.last_seen = time.monotonic()
+        if info.get("trace"):
+            st.trace_id = info["trace"]
         if self.transport == "pickle":
             self._send_to(ident, dp.encode_hello_reply(None, None, "transport=pickle"))
             return
@@ -294,7 +328,10 @@ class InferenceServer:
         if slot >= len(st.views):
             return None
         v = st.views[slot]
-        msg: dict = {"obs": v["obs"], "slot": slot, "_shm": True}
+        msg: dict = {
+            "obs": v["obs"], "slot": slot, "_shm": True,
+            "span": header.get("span", 0), "t_send": header.get("t_send", 0.0),
+        }
         if header["flags"] & dp.F_HAS_REWARD:
             msg["reward"] = np.array(v["reward"])
             msg["done"] = np.array(v["done"])
@@ -406,6 +443,7 @@ class InferenceServer:
                 self._reply(ident, msg, actions[sl])
         self._served_steps += len(obs)
         ms = (time.monotonic() - t0) * 1e3
+        self._hop_serve.append(ms)
         self._serve_ms_ewma = (
             ms if self._serve_ms_ewma is None
             else 0.1 * ms + 0.9 * self._serve_ms_ewma
@@ -513,6 +551,32 @@ class InferenceServer:
                         )
                     except queue.Empty:
                         pass
+
+    def hop_stats(self) -> dict[str, dict]:
+        """Per-hop latency percentiles for the cross-process timeline
+        (worker step -> frame in flight -> serve batch); the SEED trainer
+        merges its own queue-dwell and learn hops and emits the combined
+        ``hops`` telemetry event rendered by ``surreal_tpu diag``."""
+        from surreal_tpu.session.telemetry import latency_percentiles
+
+        out = {}
+        p = latency_percentiles(list(self._hop_transit))
+        if p is not None:
+            out["worker_to_server_ms"] = p
+        p = latency_percentiles(list(self._hop_serve))
+        if p is not None:
+            out["serve_batch_ms"] = p
+        return out
+
+    def worker_traces(self) -> dict[str, str | None]:
+        """Trace id each connected worker reported (hello / pickle
+        priming message), keyed by zmq identity — the proof trace-id
+        propagation reached a spawned worker, and diag's cross-check that
+        frames belong to THIS run."""
+        return {
+            ident.decode(errors="replace"): st.trace_id
+            for ident, st in list(self._states.items())
+        }
 
     def transport_stats(self) -> dict[str, float]:
         """Negotiated-transport mix + the zero-copy success metrics:

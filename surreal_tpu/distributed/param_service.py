@@ -67,7 +67,13 @@ class ParameterServer:
         self,
         publisher_address: str,
         bind: str | Sequence[str] = "tcp://127.0.0.1:*",
+        on_event=None,
     ):
+        # on_event(type, **fields): optional telemetry sink (SessionHooks
+        # passes Tracer.event) — fetch requests carrying a client span id
+        # are mirrored as 'param_fetch' events so diag's cross-process
+        # timeline covers the parameter-service hop
+        self._on_event = on_event
         self._ctx = zmq.Context.instance()
         self._sub = self._ctx.socket(zmq.SUB)
         self._sub.connect(publisher_address)
@@ -129,20 +135,48 @@ class ParameterServer:
                         )
                     elif (
                         req.startswith(b"fetch?")
-                        and len(req) == 14
-                        and int.from_bytes(req[6:], "little") == latest[0]
+                        and len(req) in (14, 18)
+                        and int.from_bytes(req[6:14], "little") == latest[0]
                     ):
                         # version-conditional fetch: the client already
                         # holds this snapshot — skip the blob transfer AND
                         # the client-side decompress/deserialize (steady-
                         # state pollers between publishes pay ~14 bytes
-                        # each way instead of the full pytree)
+                        # each way instead of the full pytree). 18-byte
+                        # requests append a 4-byte client span id
+                        # (trace correlation; 14 stays legal for old
+                        # clients).
                         self._rep.send_multipart([b"unchanged", b""])
+                        self._fetch_event(req, latest[0], unchanged=True)
                     else:  # any other payload = "give me latest"
                         ver, blob = latest
                         self._rep.send_multipart(
                             [ver.to_bytes(8, "little"), blob]
                         )
+                        if req.startswith(b"fetch?"):
+                            self._fetch_event(
+                                req, ver, unchanged=False, nbytes=len(blob)
+                            )
+
+    def _fetch_event(self, req: bytes, version: int, unchanged: bool,
+                     nbytes: int = 0) -> None:
+        """Mirror one span-tagged fetch into the telemetry sink (best
+        effort — a telemetry failure must never wedge the serve loop)."""
+        if self._on_event is None or len(req) < 18:
+            return
+        try:
+            self._on_event(
+                "param_fetch",
+                span=int.from_bytes(req[14:18], "little"),
+                version=int(version), unchanged=bool(unchanged),
+                bytes=int(nbytes),
+            )
+        except (TypeError, ValueError, OSError):
+            # a telemetry sink failure (unserializable field, lost log
+            # file) must not wedge the REP serve loop; Tracer.event
+            # already swallows its own IO errors, this guards foreign
+            # callbacks
+            pass
 
     def close(self) -> None:
         self._stop.set()
@@ -206,6 +240,11 @@ class ParameterClient:
         self._req.connect(server_address)
         self.template = template
         self.version = 0
+        # per-client span sequence appended to every fetch request (4
+        # bytes): the server mirrors span-tagged fetches as 'param_fetch'
+        # telemetry events, closing the param-service hop in diag's
+        # cross-process timeline
+        self.span = 0
 
     def _request_once(self, payload: bytes, timeout_ms: int):
         self._req.send(payload)
@@ -251,8 +290,10 @@ class ParameterClient:
         bounded, backed-off re-attempts and then raises TimeoutError —
         an actor against a dead session fails loudly instead of blocking
         its episode loop forever."""
+        self.span = (self.span + 1) & 0xFFFFFFFF
         ver, blob = self._request(
-            b"fetch?" + self.version.to_bytes(8, "little"),
+            b"fetch?" + self.version.to_bytes(8, "little")
+            + self.span.to_bytes(4, "little"),
             timeout_ms, retries, backoff_s,
         )
         if ver in (b"none", b"unchanged"):

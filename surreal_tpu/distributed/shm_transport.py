@@ -79,8 +79,12 @@ F_HAS_TERMINAL = 8  # an episode ended: the terminal_obs region is meaningful
                     # obs-sized terminal copy halves steady-state slab writes)
 
 # STEP header after MAGIC+kind: slot, flags, act_latency_ms,
-# pipeline_occupancy, n_episodes; then n_episodes x (return, length) f32.
-_STEP_HDR = struct.Struct("<BBffH")
+# pipeline_occupancy, span (worker step counter — the compact span id the
+# cross-process trace timeline stitches on), t_send (unix seconds at the
+# worker's send — same-host clocks, so the server's recv minus this is
+# the frame-in-flight hop), n_episodes; then n_episodes x (return,
+# length) f32.
+_STEP_HDR = struct.Struct("<BBffIdH")
 _EP_PAIR = struct.Struct("<ff")
 _ALIGN = 64  # slab field alignment (cache line)
 
@@ -171,9 +175,12 @@ class SlabSpec:
 
 # -- frame codec --------------------------------------------------------------
 
-def encode_hello(spec: SlabSpec) -> bytes:
+def encode_hello(spec: SlabSpec, trace: str | None = None) -> bytes:
+    # trace: the run-scoped trace id the worker inherited via spawn
+    # kwargs — the server records it per identity so diag can prove
+    # which run's fleet a frame belongs to
     return MAGIC + bytes([HELLO]) + json.dumps(
-        dict(spec.to_json(), pid=os.getpid())
+        dict(spec.to_json(), pid=os.getpid(), trace=trace)
     ).encode()
 
 
@@ -189,11 +196,15 @@ def encode_hello_reply(name: str | None, spec: SlabSpec | None,
 
 
 def encode_step(slot: int, flags: int, act_latency_ms: float,
-                occupancy: float, ep_returns=(), ep_lengths=()) -> bytes:
+                occupancy: float, span: int = 0, t_send: float = 0.0,
+                ep_returns=(), ep_lengths=()) -> bytes:
     n = len(ep_returns)
     parts = [
         MAGIC, bytes([STEP]),
-        _STEP_HDR.pack(slot, flags, float(act_latency_ms), float(occupancy), n),
+        _STEP_HDR.pack(
+            slot, flags, float(act_latency_ms), float(occupancy),
+            int(span) & 0xFFFFFFFF, float(t_send), n,
+        ),
     ]
     for r, l in zip(ep_returns, ep_lengths):
         parts.append(_EP_PAIR.pack(float(r), float(l)))
@@ -222,14 +233,16 @@ def decode_payload(payload: bytes) -> tuple[str, Any]:
         if kind == STEP_REPLY:
             return "step_reply", body[0]
         if kind == STEP:
-            slot, flags, lat, occ, n = _STEP_HDR.unpack_from(body, 0)
+            slot, flags, lat, occ, span, t_send, n = _STEP_HDR.unpack_from(
+                body, 0
+            )
             eps = [
                 _EP_PAIR.unpack_from(body, _STEP_HDR.size + i * _EP_PAIR.size)
                 for i in range(n)
             ]
             return "step", {
                 "slot": slot, "flags": flags, "act_latency_ms": lat,
-                "pipeline_occupancy": occ,
+                "pipeline_occupancy": occ, "span": span, "t_send": t_send,
                 "episode_returns": [e[0] for e in eps],
                 "episode_lengths": [e[1] for e in eps],
             }
@@ -377,6 +390,7 @@ class ShmWorkerTransport:
                 faults.corrupt_array(v["obs"])  # in place: it IS the slab
         frame = encode_step(
             slot, flags, lat or 0.0, msg.get("pipeline_occupancy", 0.0),
+            msg.get("span", 0), msg.get("t_send", 0.0),
             msg.get("episode_returns", ()), msg.get("episode_lengths", ()),
         )
         self._sock.send(frame, zmq.NOBLOCK if noblock else 0)
@@ -402,13 +416,16 @@ def negotiate_worker_transport(
     address: str,
     stop_event=None,
     timeout_s: float = 60.0,
+    trace: str | None = None,
 ):
     """Run the hello handshake and return the negotiated transport, or
     None when ``stop_event`` fires mid-handshake.
 
     ``mode``: 'pickle' skips the handshake; 'shm' requires a grant (raises
     on denial); 'auto' asks when the server is local and falls back to
-    pickle on denial or attach failure."""
+    pickle on denial or attach failure. ``trace`` is the run-scoped trace
+    id the hello carries (pickle-mode workers stamp it on their priming
+    message instead — env_worker.py)."""
     import time as _time
 
     import zmq
@@ -421,7 +438,7 @@ def negotiate_worker_transport(
         slot_envs, specs.obs.shape, specs.obs.dtype,
         specs.action.shape, specs.action.dtype,
     )
-    sock.send(encode_hello(spec))
+    sock.send(encode_hello(spec, trace=trace))
     deadline = _time.monotonic() + timeout_s
     while not sock.poll(100):
         if stop_event is not None and stop_event.is_set():

@@ -26,8 +26,10 @@ import jax
 from surreal_tpu.launch.recovery import RecoveryManager
 from surreal_tpu.session.checkpoint import CheckpointManager, make_checkpoint_manager
 from surreal_tpu.session.config import Config
+from surreal_tpu.session.costs import CostAccountant
 from surreal_tpu.session.interrupt import InterruptSentinel
 from surreal_tpu.session.metrics import get_logger, make_metrics_writer
+from surreal_tpu.session.profile import ProfileManager
 from surreal_tpu.session.telemetry import Tracer
 from surreal_tpu.session.tracker import PeriodicTracker
 from surreal_tpu.utils import faults
@@ -95,6 +97,16 @@ class SessionHooks:
             enabled=bool(tel.enabled) if tel is not None else True,
             name=name,
         )
+        # cross-process trace correlation: the run-scoped trace id every
+        # telemetry event carries; spawned env workers / the inference
+        # server / param clients inherit it (session/telemetry.py)
+        self.trace_id = self.tracer.trace_id
+        # cost/MFU accounting (session/costs.py): drivers register their
+        # jitted hot programs via record_program_costs; the perf/* gauges
+        # ride the metrics cadence in end_iteration below
+        self.costs = CostAccountant(
+            cfg, on_event=self.tracer.event, log=self.log
+        )
         # persistent XLA compile cache: enabled before the driver's first
         # jitted call compiles (drivers construct hooks inside run(), and
         # tracing/compilation is lazy until the first dispatch)
@@ -153,8 +165,12 @@ class SessionHooks:
 
             self._pub_agent = make_agent(learner)
             self._publisher = ParameterPublisher()
+            # on_event: fetch requests carry a client span id; the server
+            # mirrors each serve into the telemetry spine so diag's
+            # cross-process timeline covers the param-service hop too
             self._param_server = ParameterServer(
-                self._publisher.address, bind=pub.bind
+                self._publisher.address, bind=pub.bind,
+                on_event=self.tracer.event,
             )
             self._pub_every = PeriodicTracker(max(1, pub.every_n_iters))
             # discovery file: how `surreal_tpu actor` / `eval --follow`
@@ -179,11 +195,10 @@ class SessionHooks:
                 self._param_server.addresses, self._pub_every.period,
             )
 
-        prof = cfg.profiler
-        self._prof_enabled = bool(prof.enabled)
-        self._prof_start = int(prof.start_iter)
-        self._prof_stop = int(prof.start_iter) + int(prof.num_iters)
-        self._prof_active = False
+        # on-demand profiling (session/profile.py): legacy profiler knob,
+        # trigger-file captures, and the slow-iteration auto-trigger all
+        # live behind one boundary tick
+        self.profile = ProfileManager(cfg, cfg.folder, self.tracer, self.log)
         self._last_eval: dict[str, float] = {}
         self._last_train: dict[str, float] = {}
         self._metrics_every = PeriodicTracker(max(1, cfg.metrics.every_n_iters))
@@ -206,6 +221,23 @@ class SessionHooks:
             " ".join(f"{k}={v}" for k, v in sorted(info.items())),
         )
         self.tracer.event("data_plane", **info)
+
+    def record_program_costs(
+        self, name: str, jitted, *args,
+        phase: str | None = None, calls_per_phase: int = 1, **kwargs,
+    ) -> None:
+        """Register one jitted hot program with the cost accountant
+        (idempotent per name — host-loop drivers call it after their
+        first learn, when a representative batch exists). ``phase`` names
+        the tracer phase whose window times this program; programs with
+        no dedicated phase (the SEED act closure) pass None and are
+        recorded for diag without contributing to the live gauges.
+        Host-side work only (lower + HLO cost pass): safe before the
+        first dispatch and on donated-arg programs."""
+        self.costs.record_program(
+            name, jitted, *args,
+            phase=phase, calls_per_phase=calls_per_phase, **kwargs,
+        )
 
     def tune_event(self, **info) -> None:
         """Record the autotuner's build-time decision (mode, cache
@@ -387,6 +419,12 @@ class SessionHooks:
             # spans land in this row, not the next (checkpoint fires after
             # the write by design and stays in the next window)
             m.update(self.tracer.flush_phases(env_steps))
+            # perf/mfu + perf/membw_util over the same window: pure host
+            # float arithmetic from the flushed phase times and the
+            # startup-recorded program costs — zero device->host syncs
+            # beyond the metrics already synced above (transfer-guard
+            # tested in tests/test_telemetry.py)
+            m.update(self.costs.gauges(self.tracer.last_window))
             self._last_train = m
         if m or evaled:
             self.writer.write(env_steps, {**(m or {}), **evaled})
@@ -409,7 +447,7 @@ class SessionHooks:
                     )
                     if self.extra_state_fn is not None:
                         self.ckpt.save_extra(iteration, self.extra_state_fn())
-        self._profiler_tick(iteration)
+        self.profile.tick(iteration)
         # chaos-harness visibility: mirror any faults fired since the last
         # boundary into the telemetry spine (empty list in normal runs)
         for ev in faults.drain_fired():
@@ -483,30 +521,11 @@ class SessionHooks:
             **compile_cache_counts(),
         )
 
-    def _profiler_tick(self, iteration: int) -> None:
-        if not self._prof_enabled:
-            return
-        if not self._prof_active and iteration >= self._prof_start:
-            if iteration < self._prof_stop:
-                trace_dir = os.path.join(
-                    self.config.session_config.folder, "profile"
-                )
-                jax.profiler.start_trace(trace_dir)
-                self._prof_active = True
-                self.log.info("profiler trace started -> %s", trace_dir)
-        elif self._prof_active and iteration >= self._prof_stop:
-            jax.profiler.stop_trace()
-            self._prof_active = False
-            self._prof_enabled = False  # one window per run
-            self.log.info("profiler trace stopped")
-
     def close(self) -> None:
         self.interrupt.close()  # restore the process's previous handlers
         for ev in faults.drain_fired():  # tail faults since the last boundary
             self.tracer.event("fault", **ev)
-        if self._prof_active:
-            jax.profiler.stop_trace()
-            self._prof_active = False
+        self.profile.close()  # stop + record a capture cut short by exit
         if self._param_server is not None:
             self._param_server.close()
             self._param_server = None

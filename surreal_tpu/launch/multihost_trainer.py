@@ -45,6 +45,7 @@ de-pipeline the async hot loop.
 
 from __future__ import annotations
 
+import time
 from typing import Callable
 
 import jax
@@ -334,6 +335,13 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                     out_shardings=NamedSharding(self.mesh, P("dp")),
                     donate_argnums=(),  # one-shot init; nothing loop-carried
                 )(env_key)
+                if hooks is not None:
+                    # cost/MFU accounting (rank 0): lower + HLO cost pass
+                    # are rank-local — no collective, no compile
+                    hooks.record_program_costs(
+                        "train_iter", self._train_iter, state, carry,
+                        jax.random.fold_in(key, 0), phase="train_iter",
+                    )
                 while env_steps < total:
                     key, it_key, hk_key = jax.random.split(key, 3)
                     # unfenced dispatch span (see launch/trainer.py's note)
@@ -381,6 +389,13 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                     gbatch = local_batch_to_global(self.mesh, batch, batch_dim=1)
                     with tracer.span("learn"):
                         state, metrics = self._learn(state, gbatch, l_key)
+                    if hooks is not None:
+                        # first iteration only (idempotent): the learn
+                        # program needs a representative global batch
+                        hooks.record_program_costs(
+                            "learn", self._learn, state, gbatch, l_key,
+                            phase="learn",
+                        )
                     iteration += 1
                     env_steps += steps_per_iter
                     heartbeat.beat(iteration, env_steps)
@@ -490,6 +505,15 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
 
             first_call = True
             import jax.numpy as jnp
+
+            if hooks is not None:
+                # cost/MFU accounting (rank 0; lower is rank-local)
+                hooks.record_program_costs(
+                    "train_iter", self._train_iter, state, replay_state,
+                    carry, jax.random.fold_in(key, 0), jnp.float32(0),
+                    jnp.asarray(False), jnp.asarray(True),
+                    phase="train_iter",
+                )
 
             while env_steps < total:
                 key, it_key, hk_key = jax.random.split(key, 3)
@@ -636,6 +660,9 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
             # refreshes via _refresh_act_state)
             key_holder = [jax.random.fold_in(act_key, self.rank)]
             self._act_base = jax.device_put(lazy_host_state())
+            # every rank's worker fleet inherits ITS tracer's trace id
+            # (ranks > 0 mint one even with telemetry disabled)
+            self._trace_id = tracer.trace_id
             plane = self._start_data_plane(
                 self._make_act_fn(self._act_base, key_holder), stop,
                 # first chunk waits out EVERY rank's compiles
@@ -647,6 +674,11 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
             server = plane.server
             self._workers = plane.workers  # exposed for tests/fault injection
 
+            from collections import deque
+
+            from surreal_tpu.launch.seed_trainer import hop_event
+
+            learn_ms: deque = deque(maxlen=256)
             while env_steps < total:
                 with tracer.span("chunk-wait"):
                     chunk = plane.next_chunk()
@@ -654,8 +686,16 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                 staleness = server.version - int(versions.min())
                 gbatch = local_batch_to_global(self.mesh, chunk, batch_dim=1)
                 key, lkey, hk_key = jax.random.split(key, 3)
+                t_learn0 = time.perf_counter()
                 with tracer.span("learn"):
                     state, metrics = self._learn(state, gbatch, lkey)
+                learn_ms.append((time.perf_counter() - t_learn0) * 1e3)
+                if hooks is not None:
+                    # first iteration only (idempotent)
+                    hooks.record_program_costs(
+                        "learn", self._learn, state, gbatch, lkey,
+                        phase="learn",
+                    )
                 with tracer.span("param-publish"):
                     server.set_act_fn(
                         self._make_act_fn(
@@ -683,10 +723,15 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                         **server.queue_stats(),
                         **(server.episode_stats() or {}),
                     )
-                    _, stop_flag = hooks.end_iteration(
+                    m_row, stop_flag = hooks.end_iteration(
                         iteration, env_steps, lazy_host_state, hk_key,
                         metrics, on_metrics,
                     )
+                    if m_row is not None:
+                        # per-hop latency percentiles (host deques only)
+                        hooks.tracer.event(
+                            "hops", **hop_event(server, plane, learn_ms)
+                        )
                 if self._maybe_agree_stop(iteration, stop_flag, metrics_every):
                     break
             return state, self._end_session(

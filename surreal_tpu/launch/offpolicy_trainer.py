@@ -496,6 +496,14 @@ class OffPolicyTrainer:
             include_replay = bool(
                 cfg.checkpoint.get("include_replay", False)
             ) and hooks.ckpt is not None
+            # cost/MFU accounting: register the fused program once before
+            # the first dispatch (host-side lower + HLO cost pass only)
+            hooks.record_program_costs(
+                "train_iter", self._train_iter, state, replay_state, carry,
+                jax.random.fold_in(key, 0), jnp.float32(0),
+                jnp.asarray(False), jnp.asarray(True),
+                phase="train_iter",
+            )
             first_call = True
             while env_steps < total:
                 f = faults.fire("trainer.iteration")
@@ -741,6 +749,12 @@ class OffPolicyTrainer:
                                 replay_state, batch, info = self._sample(replay_state, skey)
                         with hooks.tracer.span("learn"):
                             state, metrics = self._learn(state, batch, skey)
+                        # cost accounting, first update only (idempotent;
+                        # needs a representative replay batch to lower)
+                        hooks.record_program_costs(
+                            "learn", self._learn, state, batch, skey,
+                            phase="learn",
+                        )
                         td_abs = metrics.pop("priority/td_abs")
                         if self.prioritized:
                             replay_state = self._update_prio(replay_state, info["idx"], td_abs)

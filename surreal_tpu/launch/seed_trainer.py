@@ -27,6 +27,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Callable
 
 import jax
@@ -39,6 +40,24 @@ from surreal_tpu.utils import faults
 
 
 _FROM_CONFIG = object()  # sentinel: None is a meaningful max_staleness value
+
+
+def hop_event(server, plane, learn_ms) -> dict:
+    """Assemble the per-hop latency percentiles for one ``hops``
+    telemetry event — the stitched cross-process timeline (worker step ->
+    frame in flight -> serve batch -> queue dwell -> learn), rendered by
+    ``surreal_tpu diag``. The learn hop measures DISPATCH time (the span
+    discipline of session/telemetry.py), named accordingly."""
+    from surreal_tpu.session.telemetry import latency_percentiles
+
+    hops = dict(server.hop_stats())
+    p = latency_percentiles(list(plane.dwell_ms))
+    if p is not None:
+        hops["chunk_queue_dwell_ms"] = p
+    p = latency_percentiles(list(learn_ms))
+    if p is not None:
+        hops["learn_dispatch_ms"] = p
+    return hops
 
 
 class _DataPlane:
@@ -69,6 +88,10 @@ class _DataPlane:
         self._timeout = first_timeout
         self.steady_timeout = 30.0
         self.last_chunk_age_s = 0.0  # queue dwell of the last chunk served
+        # rolling queue-dwell samples for the per-hop latency percentiles
+        # (the 'hops' telemetry event; appended by whichever thread runs
+        # next_chunk — GIL-atomic, snapshot via list() on the reader)
+        self.dwell_ms: deque = deque(maxlen=256)
         # exponential respawn backoff (satellite of ISSUE 5): a worker that
         # dies at startup used to respawn-loop hot — burning CPU on env
         # construction and flooding the server with hellos. First death
@@ -134,6 +157,7 @@ class _DataPlane:
                 self.last_chunk_age_s = time.monotonic() - chunk.pop(
                     "_t_ready", time.monotonic()
                 )
+                self.dwell_ms.append(self.last_chunk_age_s * 1e3)
                 return chunk
             except queue.Empty:
                 self.supervise()
@@ -237,6 +261,10 @@ class SEEDTrainer:
         # chaos harness: worker indices whose FIRST process spawn already
         # carried the fault plan (see _spawn_one's respawn note)
         self._fault_plan_sent: set[int] = set()
+        # cross-process trace correlation: run() sets this from hooks
+        # before the data plane spawns, so every worker (thread or
+        # process) inherits the run-scoped trace id via spawn kwargs
+        self._trace_id: str | None = None
         n_envs = int(config.env_config.num_envs)
         # pipelined sub-slices halve the per-chunk batch width, so the
         # learn program compiles once per width: keep widths uniform (even
@@ -304,6 +332,7 @@ class SEEDTrainer:
             transport=self.worker_transport,
             pipeline=self.pipeline_workers,
             server_silence_s=self.worker_silence_s,
+            trace_id=self._trace_id,
         )
         if self.worker_mode == "process":
             import multiprocessing as mp
@@ -364,6 +393,7 @@ class SEEDTrainer:
             max_wait_ms=5.0,
             transport="pickle" if self.worker_transport == "pickle" else "auto",
             auto_tune=True,
+            trace_id=self._trace_id,
             # robustness: nonfinite obs payloads (a corrupt slab slot, a
             # worker gone insane) are sanitized + counted rather than
             # poisoning the whole micro-batch. `.get` keeps old configs
@@ -444,6 +474,8 @@ class SEEDTrainer:
             if self.tune_decision.mode != "off":
                 hooks.tune_event(**self.tune_decision.telemetry())
             key_holder = [act_key]
+            # workers inherit the run-scoped trace id via spawn kwargs
+            self._trace_id = hooks.trace_id
             # the FIRST chunk waits out the policy's XLA compiles plus a
             # full unroll of round trips (can be minutes on a tunneled
             # TPU); workers keep their own 120s liveness budget per step,
@@ -451,6 +483,21 @@ class SEEDTrainer:
             plane = self._start_data_plane(
                 self._make_act_fn(state, key_holder), stop,
                 first_chunk_timeout=600.0,
+            )
+            # cost accounting for the act closure: one policy forward at
+            # the coalesced fleet width, padded to the power of two the
+            # act_fn actually compiles for. No tracer phase times it (it
+            # serves on the server thread), so it is recorded for diag
+            # but excluded from the live MFU gauges.
+            total_envs = self.num_workers * int(self.config.env_config.num_envs)
+            padded = 1 << max(total_envs - 1, 0).bit_length()
+            hooks.record_program_costs(
+                "act", self._jit_act, state,
+                jax.ShapeDtypeStruct(
+                    (padded, *self.specs.obs.shape), self.specs.obs.dtype
+                ),
+                jax.random.fold_in(act_key, 0), mode="training",
+                phase=None,
             )
             server = plane.server
             self._workers = plane.workers  # exposed for tests/fault injection
@@ -488,6 +535,7 @@ class SEEDTrainer:
             dropped_stale = 0
             discarded_steps = 0
             dp_event_emitted = False
+            learn_ms: deque = deque(maxlen=256)  # learn-hop samples
 
             def data_plane_extras() -> dict:
                 """One source of truth for the drop/eviction/episode
@@ -535,8 +583,15 @@ class SEEDTrainer:
                         break
                     continue
                 key, lkey, hk_key = jax.random.split(key, 3)
+                t_learn0 = time.perf_counter()
                 with hooks.tracer.span("learn"):
                     state, metrics = self._learn(state, batch, lkey)
+                learn_ms.append((time.perf_counter() - t_learn0) * 1e3)
+                # cost accounting, first learn only (idempotent; needs a
+                # representative staged chunk to lower)
+                hooks.record_program_costs(
+                    "learn", self._learn, state, batch, lkey, phase="learn"
+                )
                 with hooks.tracer.span("param-publish"):
                     server.set_act_fn(self._make_act_fn(state, key_holder))
                 iteration += 1
@@ -557,9 +612,15 @@ class SEEDTrainer:
                     **{"staleness/updates_behind": float(staleness)},
                     **data_plane_extras(),
                 )
-                _, stop_flag = hooks.end_iteration(
+                m_row, stop_flag = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics
                 )
+                if m_row is not None:
+                    # per-hop latency percentiles ride the metrics cadence
+                    # (host-side deques only — no device work)
+                    hooks.tracer.event(
+                        "hops", **hop_event(server, plane, learn_ms)
+                    )
                 if hooks.recovery.pending:
                     rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
                     state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps

@@ -260,6 +260,14 @@ class Trainer:
 
             if self.device_mode:
                 carry = self.init_loop_state(env_key)
+                # cost/MFU accounting: register the fused program's XLA
+                # cost model once, before the first dispatch (host-side
+                # lower + HLO cost pass — no compile, no transfers; the
+                # 'train_iter' phase spans below time it)
+                hooks.record_program_costs(
+                    "train_iter", self._train_iter, state, carry,
+                    jax.random.fold_in(key, 0), phase="train_iter",
+                )
                 while env_steps < total:
                     f = faults.fire("trainer.iteration")
                     if f is not None:
@@ -335,6 +343,18 @@ class Trainer:
                 )
             with hooks.tracer.span("learn"):
                 state, metrics = self._learn(state, batch, l_key)
+            # cost accounting, first iteration only (idempotent): the
+            # learn program needs a representative batch to lower, and
+            # the act program runs horizon times inside each 'rollout'
+            # phase (its MFU contribution is a documented lower bound —
+            # the phase also times env stepping)
+            hooks.record_program_costs(
+                "learn", self._learn, state, batch, l_key, phase="learn"
+            )
+            hooks.record_program_costs(
+                "act", self._act, state, batch["obs"][0], l_key,
+                phase="rollout", calls_per_phase=self.horizon,
+            )
             iteration += 1
             env_steps += steps_per_iter
             recent_returns.extend(ep_stats["returns"])
@@ -422,6 +442,15 @@ class Trainer:
                 with tracer.span("learn"):
                     state, metrics = self._learn(state, batch, l_key)
                 act_state[0] = state  # device-resident; no host copy
+                # cost accounting, first iteration only (see the
+                # alternation loop's note)
+                hooks.record_program_costs(
+                    "learn", self._learn, state, batch, l_key, phase="learn"
+                )
+                hooks.record_program_costs(
+                    "act", self._act, state, batch["obs"][0], l_key,
+                    phase="rollout", calls_per_phase=self.horizon,
+                )
                 iteration += 1
                 env_steps += steps_per_iter
                 recent_returns.extend(ep_stats["returns"])

@@ -789,6 +789,28 @@ def run_tune(args) -> int:
     return 0
 
 
+def run_profile(args) -> int:
+    """Request an on-demand profiler capture from a LIVE training session:
+    drops ``<folder>/profile.trigger``, which the session's ProfileManager
+    (session/profile.py) polls at iteration boundaries — the capture
+    lands under ``<folder>/telemetry/profiles/`` and is announced as a
+    ``profile`` telemetry event (``surreal_tpu diag`` lists it). Pure
+    file writing: works off-chip, requires no connection to the session."""
+    if not os.path.isdir(args.folder):
+        print(f"no session folder {args.folder!r}", file=sys.stderr)
+        return 2
+    from surreal_tpu.session.profile import write_trigger
+
+    path = write_trigger(args.folder, num_iters=args.iters)
+    print(
+        f"profile trigger written: {path}\n"
+        "a live session (session_config.profile.trigger_file=true, the "
+        "default) will capture at its next iteration boundary; check "
+        f"`surreal_tpu diag {args.folder}` for the capture."
+    )
+    return 0
+
+
 def run_diag(args) -> int:
     """Offline session diagnosis from the telemetry spine's JSONL logs
     (session/telemetry.py): phase-time breakdown, training-health
@@ -931,6 +953,16 @@ def main(argv=None) -> int:
                          "style artifact (keyed by fingerprint)")
     tu.set_defaults(fn=run_tune, total_steps=None, restore_from=None,
                     workers=None)
+
+    p = sub.add_parser("profile", help="ask a LIVE session for an "
+                       "on-demand jax.profiler capture (writes "
+                       "<folder>/profile.trigger; the capture lands under "
+                       "<folder>/telemetry/profiles/)")
+    p.add_argument("folder", help="the live session's folder")
+    p.add_argument("--iters", type=int, default=None,
+                   help="capture window length in iterations (default: "
+                        "the session's session_config.profile.num_iters)")
+    p.set_defaults(fn=run_profile)
 
     d = sub.add_parser("diag", help="offline session diagnosis from the "
                        "telemetry JSONL log: phase times, health summary, "

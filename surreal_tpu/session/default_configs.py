@@ -258,10 +258,46 @@ BASE_SESSION_CONFIG = Config(
         max_steps=None,        # per-episode step cap (None -> env time limit
                                # on device, 10k on host)
     ),
-    profiler=Config(
-        enabled=False,     # jax.profiler trace window (SURVEY.md §5.1)
-        start_iter=20,     # after compile + warmup
+    # cost/MFU accounting (session/costs.py): per-program FLOPs / bytes
+    # from XLA's cost model, recorded once per hot program at driver
+    # startup, plus live perf/mfu + perf/membw_util gauges at the metrics
+    # cadence (pure host arithmetic over already-recorded phase times —
+    # zero extra device->host syncs, transfer-guard tested).
+    perf=Config(
+        enabled=True,
+        # peak-spec override: peak FLOP/s and memory bytes/s used as the
+        # MFU / bandwidth-utilization denominators. None resolves from
+        # the device-kind table in session/costs.py (TPU generations +
+        # a nominal CPU figure); set both for unlisted hardware.
+        peak_flops=None,
+        peak_membw=None,
+        # memory_analysis needs a real XLA compile (not shared with the
+        # jit call cache on this pin): 'auto' runs it only when cheap
+        # (single-process with the persistent compile cache active —
+        # the AOT compile then warms the same cache the first jit call
+        # reads); True/False force it
+        memory_analysis="auto",
+    ),
+    # on-demand profiling (session/profile.py): jax.profiler windows
+    # captured at iteration boundaries into <folder>/telemetry/profiles/,
+    # each logged as a 'profile' telemetry event (rendered by diag).
+    profile=Config(
+        # watch <folder>/profile.trigger (written by `surreal_tpu
+        # profile <folder>`, checked at most once per second): when it
+        # appears, capture a num_iters window starting at the next
+        # iteration boundary, then remove the file
+        trigger_file=True,
         num_iters=5,
+        # auto-trigger: an iteration slower than slow_iter_factor x the
+        # iteration-time EWMA starts a capture (None = off). Detection is
+        # host wall-clock between iteration boundaries — no device syncs.
+        slow_iter_factor=None,
+        max_auto_captures=2,  # bound auto captures per run
+    ),
+    profiler=Config(
+        enabled=False,     # legacy fixed trace window (SURVEY.md §5.1);
+        start_iter=20,     # still honored — captures now land under
+        num_iters=5,       # telemetry/profiles/ with the on-demand ones
     ),
     publish=Config(
         # live parameter publishing (reference: the learner published every

@@ -59,6 +59,32 @@ line, ``t`` = unix seconds):
                      utils/faults.py — drained into the spine by
                      SessionHooks so a chaos run documents what it
                      survived)
+    {"type": "program_cost", "t": ..., "name": "...", "flops": F,
+     "bytes_accessed": B, "arithmetic_intensity": AI, "phase": "...",
+     "peak_flops": ..., "peak_membw": ..., ...}
+                    (cost/MFU accounting, session/costs.py: one per
+                     registered hot program, recorded at driver startup)
+    {"type": "hops", "t": ..., "<hop>_ms": {"p50": ..., "p90": ...,
+     "p99": ..., "n": N}, ...}
+                    (per-hop latency percentiles of the SEED
+                     cross-process timeline: worker_to_server,
+                     serve_batch, chunk_queue_dwell, learn_dispatch —
+                     emitted at the metrics cadence)
+    {"type": "profile", "t": ..., "dir": "...", "reason":
+     "trigger_file|slow_iter(...)|profiler_knob", "start_iter": ...,
+     "end_iter": ...}
+                    (on-demand profiler captures, session/profile.py —
+                     the trace artifact lives under dir)
+    {"type": "param_fetch", "t": ..., "span": S, "version": V,
+     "unchanged": ..., "bytes": B}
+                    (parameter-service hop: span-tagged client fetches
+                     mirrored by ParameterServer when SessionHooks owns
+                     it)
+
+Every event additionally carries ``trace`` (the run-scoped trace id
+SessionHooks mints and spawned components inherit) and ``seq`` (a
+per-process span-sequence counter) — the correlation keys diag uses to
+stitch one cross-process timeline.
 
 Heartbeats live per rank in ``telemetry/heartbeat_rank<k>.jsonl``:
 
@@ -78,10 +104,27 @@ import json
 import os
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 
 TELEMETRY_DIR = "telemetry"
 EVENTS_FILE = "events.jsonl"
+PROFILES_DIR = "profiles"  # <folder>/telemetry/profiles/<tag>/ captures
+
+
+def latency_percentiles(samples) -> dict[str, float] | None:
+    """{p50, p90, p99, n} of a latency sample window (pure python — used
+    by the inference server's hop stats and the SEED data plane; no numpy
+    so the server thread never allocates for bookkeeping)."""
+    xs = sorted(float(x) for x in samples)
+    if not xs:
+        return None
+    n = len(xs)
+
+    def pct(p: float) -> float:
+        return xs[min(n - 1, int(p * (n - 1) + 0.5))]
+
+    return {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99), "n": n}
 
 
 class Tracer:
@@ -94,12 +137,24 @@ class Tracer:
     """
 
     def __init__(self, folder: str | None, enabled: bool = True,
-                 name: str = "train"):
+                 name: str = "train", trace_id: str | None = None):
         self.enabled = bool(enabled) and folder is not None
         self._lock = threading.Lock()
         self._phases: dict[str, list] = {}  # name -> [count, total_s, max_s]
         self._f = None
         self.path = None
+        # cross-process trace correlation (ISSUE 6): a run-scoped trace id
+        # stamped (with a per-process span-sequence counter) into every
+        # event; spawned env workers / the inference server / the param
+        # service inherit it so diag can stitch one cross-process
+        # timeline. Minted even when disabled — ranks > 0 still forward
+        # it to the components they spawn.
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self._seq = 0
+        # last flushed phase window ({name: {count, total_s, max_ms}}) —
+        # the cost accountant (session/costs.py) derives the perf/* gauges
+        # from it without re-reading the event log
+        self.last_window: dict[str, dict] = {}
         if self.enabled:
             try:
                 tel_dir = os.path.join(folder, TELEMETRY_DIR)
@@ -118,11 +173,18 @@ class Tracer:
         """Append one event line. Fields must be JSON-serializable."""
         if not self.enabled:
             return
-        line = json.dumps({"type": type_, "t": time.time(), **fields},
-                          default=float)
         with self._lock:
             if self._f is None:
                 return
+            self._seq += 1
+            line = json.dumps(
+                {
+                    "type": type_, "t": time.time(),
+                    "trace": self.trace_id, "seq": self._seq,
+                    **fields,
+                },
+                default=float,
+            )
             try:
                 self._f.write(line + "\n")
             except OSError:
@@ -176,6 +238,7 @@ class Tracer:
                 for k, (c, t, mx) in self._phases.items()
             }
             self._phases.clear()
+        self.last_window = phases
         if not phases:
             return {}
         self.event("phases", step=int(step), phases=phases)
@@ -245,6 +308,9 @@ class HeartbeatWriter:
         rec = {
             "type": "heartbeat", "t": time.time(), "rank": self.rank,
             "iteration": int(iteration), "env_steps": int(env_steps),
+            # cadence rides in the record so diag can flag a rank whose
+            # newest beat is older than 3x its own cadence as DEAD
+            "every_s": self.every_s,
         }
         try:
             with open(self._path, "a") as f:
@@ -259,8 +325,15 @@ _HEALTH_PREFIXES = ("health/", "loss/", "policy/kl", "episode/return")
 
 
 def _iter_jsonl(path):
+    """Yield one JSON object per parseable line, tolerating a
+    partially-written trailing line. Two torn-tail shapes exist after a
+    chaos-harness kill (PR 5) mid-``write``: an incomplete JSON text
+    (JSONDecodeError — skipped per line) and a line truncated INSIDE a
+    multi-byte UTF-8 sequence, which raises UnicodeDecodeError from the
+    file iterator itself unless decoding is lossy — ``errors='replace'``
+    turns it into a replacement char the per-line parse then skips."""
     try:
-        with open(path) as f:
+        with open(path, errors="replace") as f:
             for line in f:
                 line = line.strip()
                 if not line:
@@ -288,6 +361,11 @@ def diag_summary(folder: str) -> dict | None:
     health: dict[str, dict] = {}
     compile_cache = None
     data_plane = None
+    trace_id = None
+    programs: dict[str, dict] = {}   # program_cost events (last per name)
+    perf_last: dict[str, float] = {}  # perf/* gauges from the last row
+    hops = None                      # last 'hops' event's percentiles
+    profiles: list[dict] = []        # 'profile' capture events
     tune = None
     tune_hits = tune_misses = 0
     recovery_counts: dict[str, int] = {}
@@ -303,6 +381,8 @@ def diag_summary(folder: str) -> dict | None:
         if isinstance(t, (int, float)):
             t_first = t if t_first is None else min(t_first, t)
             t_last = t if t_last is None else max(t_last, t)
+        if trace_id is None and ev.get("trace"):
+            trace_id = ev["trace"]
         if ev.get("type") == "phases":
             step = ev.get("step")
             if isinstance(step, int) and step >= 0:  # -1 = at-close flush
@@ -325,12 +405,12 @@ def diag_summary(folder: str) -> dict | None:
             # the last event is the settled negotiation (SEED drivers emit
             # one after the first learn and one at run end)
             data_plane = {
-                k: v for k, v in ev.items() if k not in ("type", "t")
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
         elif ev.get("type") == "tune":
             # the last event is the active decision; hit/miss counts
             # accumulate over the session (trainer builds + CLI runs)
-            tune = {k: v for k, v in ev.items() if k not in ("type", "t")}
+            tune = {k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")}
             if ev.get("hit"):
                 tune_hits += 1
             else:
@@ -339,18 +419,38 @@ def diag_summary(folder: str) -> dict | None:
             kind = str(ev.get("kind", "?"))
             recovery_counts[kind] = recovery_counts.get(kind, 0) + 1
             recovery_last = {
-                k: v for k, v in ev.items() if k not in ("type", "t")
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
         elif ev.get("type") == "fault":
             fault_count += 1
             site = str(ev.get("site", "?"))
             fault_sites[site] = fault_sites.get(site, 0) + 1
             fault_last = {
-                k: v for k, v in ev.items() if k not in ("type", "t")
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
+        elif ev.get("type") == "program_cost":
+            name = str(ev.get("name", "?"))
+            programs[name] = {
+                k: v for k, v in ev.items()
+                if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "hops":
+            # last event wins: the window's rolling-deque percentiles
+            hops = {
+                k: v for k, v in ev.items()
+                if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "profile":
+            profiles.append({
+                k: v for k, v in ev.items()
+                if k not in ("type", "t", "trace", "seq")
+            })
         elif ev.get("type") == "metrics":
             last_step = ev.get("step", last_step)
             vals = ev.get("values") or {}
+            for k, v in vals.items():
+                if k.startswith("perf/") and isinstance(v, (int, float)):
+                    perf_last[k] = v
             if vals.get("health/nonfinite", 0):
                 nonfinite_windows += 1
             for k, v in vals.items():
@@ -369,16 +469,39 @@ def diag_summary(folder: str) -> dict | None:
                 h["n"] += 1
 
     heartbeats = {}
+    now = time.time()
     for path in hb_paths:
         last = None
+        prev_t = None
+        deltas: list[float] = []
         for rec in _iter_jsonl(path):
             if rec.get("type") == "heartbeat":
+                t = rec.get("t")
+                if isinstance(t, (int, float)) and prev_t is not None:
+                    deltas.append(t - prev_t)
+                prev_t = t if isinstance(t, (int, float)) else prev_t
                 last = rec
         if last is not None:
-            heartbeats[int(last.get("rank", -1))] = last
+            # staleness: a rank whose newest beat is older than 3x its
+            # cadence is flagged DEAD instead of silently looking fine.
+            # Cadence comes from the record (new runs), else is inferred
+            # from the observed beat deltas (old logs), else defaults.
+            cadence = last.get("every_s")
+            if not isinstance(cadence, (int, float)) or cadence <= 0:
+                cadence = (
+                    sorted(deltas)[len(deltas) // 2] if deltas else 10.0
+                )
+            age = now - float(last.get("t", now))
+            heartbeats[int(last.get("rank", -1))] = {
+                **last,
+                "age_s": age,
+                "cadence_s": float(cadence),
+                "dead": age > 3.0 * float(cadence),
+            }
 
     return {
         "folder": folder,
+        "trace_id": trace_id,
         "events": len(events),
         "wall_s": (t_last - t_first) if (t_first is not None and t_last is not None) else 0.0,
         "last_step": last_step,
@@ -399,6 +522,10 @@ def diag_summary(folder: str) -> dict | None:
         ),
         "nonfinite_windows": nonfinite_windows,
         "heartbeats": heartbeats,
+        "programs": programs,
+        "perf": perf_last,
+        "hops": hops,
+        "profiles": profiles,
     }
 
 
@@ -412,7 +539,8 @@ def diag_report(folder: str) -> str | None:
     lines = [
         f"Telemetry diag — {s['folder']}",
         f"{s['events']} events over {wall:.1f} s"
-        + (f", last step {s['last_step']}" if s["last_step"] is not None else ""),
+        + (f", last step {s['last_step']}" if s["last_step"] is not None else "")
+        + (f", trace {s['trace_id']}" if s.get("trace_id") else ""),
         "",
         "Phase-time breakdown",
     ]
@@ -484,6 +612,9 @@ def diag_report(folder: str) -> str | None:
                 )
             if len(trials) > 16:
                 lines.append(f"    ... {len(trials) - 16} more")
+    perf_lines = _performance_lines(s)
+    if perf_lines:
+        lines += ["", "Performance"] + perf_lines
     rec = s.get("recovery")
     if rec is not None:
         counts = ", ".join(
@@ -528,17 +659,101 @@ def diag_report(folder: str) -> str | None:
         lines.append("  (no metrics rows recorded)")
     lines += ["", "Heartbeats"]
     if s["heartbeats"]:
-        now = time.time()
         lines.append(
             f"  {'rank':>4} {'age s':>8} {'iteration':>10} {'env_steps':>12}"
+            f"  status"
         )
+        dead_ranks = []
         for rank in sorted(s["heartbeats"]):
             hb = s["heartbeats"][rank]
-            age = now - float(hb.get("t", now))
+            age = float(hb.get("age_s", 0.0))
+            dead = bool(hb.get("dead"))
+            if dead:
+                dead_ranks.append(rank)
             lines.append(
                 f"  {rank:>4} {age:>8.1f} {hb.get('iteration', 0):>10} "
-                f"{hb.get('env_steps', 0):>12}"
+                f"{hb.get('env_steps', 0):>12}  "
+                + (
+                    f"DEAD (> 3x {hb.get('cadence_s', 0.0):.0f}s cadence)"
+                    if dead else "alive"
+                )
+            )
+        if dead_ranks:
+            lines.append(
+                f"  !! rank(s) {', '.join(str(r) for r in dead_ranks)} "
+                "stopped heartbeating — wedged, killed, or the run ended"
             )
     else:
         lines.append("  (none recorded — single-host session)")
     return "\n".join(lines)
+
+
+def _performance_lines(s: dict) -> list[str]:
+    """The diag 'Performance' section: per-program roofline numbers
+    (FLOPs / bytes / arithmetic intensity from program_cost events), the
+    live perf/* gauges from the last metrics row, per-hop latency
+    percentiles (the stitched cross-process timeline), and captured
+    profiler traces. Empty list when the session recorded none of them."""
+    progs = s.get("programs") or {}
+    perf = s.get("perf") or {}
+    hops = s.get("hops") or {}
+    profiles = s.get("profiles") or []
+    lines: list[str] = []
+    if progs:
+        any_rec = next(iter(progs.values()))
+        kind = any_rec.get("device_kind", "?")
+        pk_f, pk_b = any_rec.get("peak_flops"), any_rec.get("peak_membw")
+        src = any_rec.get("peak_source", "?")
+        lines.append(
+            f"  device {kind} — peak "
+            + (f"{pk_f / 1e12:.1f} TFLOP/s" if pk_f else "? FLOP/s")
+            + ", "
+            + (f"{pk_b / 1e9:.0f} GB/s" if pk_b else "? B/s")
+            + f" ({src})"
+        )
+        lines.append(
+            f"  {'program':<16} {'GFLOPs/call':>12} {'MB/call':>10} "
+            f"{'arith int':>10} {'phase':<12}"
+        )
+        for name in sorted(progs):
+            p = progs[name]
+            ai = p.get("arithmetic_intensity")
+            lines.append(
+                f"  {name:<16} {p.get('flops', 0) / 1e9:>12.3f} "
+                f"{p.get('bytes_accessed', 0) / 1e6:>10.2f} "
+                + (f"{ai:>10.2f} " if ai else f"{'n/a':>10} ")
+                + f"{p.get('phase') or '(unphased)':<12}"
+            )
+    if perf:
+        bits = []
+        if "perf/mfu" in perf:
+            bits.append(f"mfu {perf['perf/mfu'] * 100:.3f}%")
+        if "perf/membw_util" in perf:
+            bits.append(f"membw_util {perf['perf/membw_util'] * 100:.2f}%")
+        if "perf/flops_per_s" in perf:
+            bits.append(
+                f"flops/s {perf['perf/flops_per_s'] / 1e9:.2f} G"
+            )
+        lines.append("  gauges (last metrics row): " + ", ".join(bits))
+    if hops:
+        lines.append("  per-hop latency (cross-process timeline):")
+        for hop in sorted(hops):
+            st = hops[hop]
+            if not isinstance(st, dict):
+                continue
+            lines.append(
+                f"    {hop:<24} p50 {st.get('p50', 0):>8.2f} ms  "
+                f"p90 {st.get('p90', 0):>8.2f}  p99 {st.get('p99', 0):>8.2f}"
+                f"  (n={st.get('n', 0)})"
+            )
+    if profiles:
+        lines.append(f"  profiler captures ({len(profiles)}):")
+        for p in profiles[-8:]:
+            lines.append(
+                f"    {p.get('dir', '?')} — reason={p.get('reason', '?')}"
+                + (
+                    f", iters {p.get('start_iter')}-{p.get('end_iter')}"
+                    if p.get("start_iter") is not None else ""
+                )
+            )
+    return lines

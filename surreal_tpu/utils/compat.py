@@ -48,6 +48,20 @@ def axis_size(axis_name) -> int:
         return int(frame if isinstance(frame, int) else frame.size)
 
 
+def device_kind() -> str:
+    """Device-kind string of the default backend (e.g. 'TPU v5 lite',
+    'cpu'), or 'unknown' when the backend cannot initialize — cost
+    accounting (session/costs.py) must degrade to no-peak, never raise.
+    The spelling of the kind string varies across jaxlib pins, which is
+    why the peak table matches by substring."""
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
 # -- persistent XLA compile cache ---------------------------------------------
 # The flag spelling moved across jax versions (jax_compilation_cache_dir has
 # been stable, but the persistent-cache eligibility knobs appeared later and
@@ -79,6 +93,18 @@ def _install_cache_listener() -> None:
         _CACHE_LISTENER_INSTALLED = True
     except Exception:
         pass
+
+
+def compile_cache_active() -> bool:
+    """True when a persistent compile-cache dir is currently configured —
+    the signal session/costs.py uses to decide an extra AOT compile
+    (memory_analysis) is a disk deserialize rather than minutes of XLA."""
+    import jax
+
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except AttributeError:
+        return False
 
 
 def compile_cache_counts() -> dict:

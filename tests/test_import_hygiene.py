@@ -273,6 +273,39 @@ def test_no_swallowed_exceptions_in_supervised_code():
     )
 
 
+def test_perf_gauges_appear_in_registry():
+    """Gauge-registry lint (ISSUE 6 satellite): every ``perf/*`` gauge
+    name emitted anywhere in the package must appear in the documented
+    registry (``session/costs.py::GAUGE_REGISTRY``) — an undocumented
+    gauge is invisible to diag readers and to the README's knob table.
+    The scan covers string literals, so a gauge built by concatenation
+    would dodge it; our style writes metric names as whole literals (the
+    donation/unroll lints rely on the same convention)."""
+    import re
+
+    from surreal_tpu.session.costs import GAUGE_REGISTRY
+
+    lit = re.compile(r"[\"'](perf/[a-z0-9_]+)[\"']")
+    bad = []
+    for path in sorted(_PKG_ROOT.rglob("*.py")):
+        if path.name == "costs.py":
+            continue  # the registry itself defines the names
+        src = path.read_text()
+        for m in lit.finditer(src):
+            if m.group(1) not in GAUGE_REGISTRY:
+                line = src.count("\n", 0, m.start()) + 1
+                bad.append(
+                    f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
+                )
+    assert not bad, (
+        "perf/* gauges emitted but not documented in "
+        "session/costs.py::GAUGE_REGISTRY:\n" + "\n".join(bad)
+    )
+    # and the registry names must parse as gauge literals themselves
+    for name in GAUGE_REGISTRY:
+        assert name.startswith("perf/"), name
+
+
 def test_graft_entry_import_initializes_no_backend():
     """__graft_entry__ itself must also be import-clean: the driver imports
     it before calling dryrun_multichip, which is where platform selection

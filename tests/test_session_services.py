@@ -349,7 +349,9 @@ def test_evaluator_records_video(tmp_path):
 def test_profiler_trace_window_writes_profile(tmp_path):
     """SURVEY §5.1: the session-config profiler hook must capture a
     jax.profiler trace window around the configured iterations and leave
-    the TensorBoard profile artifacts under <folder>/profile."""
+    the TensorBoard profile artifacts under <folder>/telemetry/profiles/
+    (the on-demand profiling layer's unified capture location —
+    session/profile.py folds the legacy window into it)."""
     from surreal_tpu.launch.trainer import Trainer
 
     folder = str(tmp_path / "prof_run")
@@ -366,7 +368,10 @@ def test_profiler_trace_window_writes_profile(tmp_path):
         ),
     ).extend(base_config())
     Trainer(cfg).run()
-    trace_files = glob.glob(os.path.join(folder, "profile", "**", "*"), recursive=True)
+    trace_files = glob.glob(
+        os.path.join(folder, "telemetry", "profiles", "**", "*"),
+        recursive=True,
+    )
     assert any(os.path.isfile(f) for f in trace_files), trace_files
 
 

@@ -211,6 +211,60 @@ def test_fused_train_iter_no_syncs_off_metrics_cadence(tmp_path):
         hooks.close()
 
 
+def test_perf_gauges_add_no_syncs_beyond_metrics(tmp_path):
+    """Transfer-guard proof for the ISSUE-6 cost/MFU gauges: with hot
+    programs REGISTERED with the cost accountant, the cadence-firing
+    end_iteration — perf/mfu + perf/membw_util computation included —
+    performs zero device->host transfers beyond the metrics the caller
+    already synced. Proven by pre-syncing the metrics to host floats and
+    running the ENTIRE end_iteration (and the gauge arithmetic inside
+    it) under disallow_device_to_host."""
+    from surreal_tpu.launch.hooks import SessionHooks
+    from surreal_tpu.launch.rollout import init_device_carry
+    from surreal_tpu.launch.trainer import Trainer
+
+    cfg = _session_cfg(tmp_path / "exp_perf_guard", every_n_iters=1)
+    trainer = Trainer(cfg)
+    key = jax.random.key(0)
+    key, init_key, env_key = jax.random.split(key, 3)
+    state = trainer.learner.init(init_key)
+    carry = init_device_carry(trainer.env, env_key, trainer.num_envs)
+    key, wk = jax.random.split(key)
+    state, carry, metrics = trainer._train_iter(state, carry, wk)
+    jax.block_until_ready(metrics)
+
+    hooks = SessionHooks(cfg, trainer.learner)
+    try:
+        # program registration itself is host-side (lower + HLO cost
+        # pass): legal under the guard too — prove it there
+        with jax.transfer_guard_device_to_host("disallow"):
+            hooks.record_program_costs(
+                "train_iter", trainer._train_iter, state, carry, wk,
+                phase="train_iter",
+            )
+        assert "train_iter" in hooks.costs.programs
+        hooks.begin_run(0, 0)
+        steps_per_iter = trainer.horizon * trainer.num_envs
+        key, it_key, hk_key = jax.random.split(key, 3)
+        with hooks.tracer.span("train_iter"):
+            state, carry, metrics = trainer._train_iter(state, carry, it_key)
+        # the caller's one sync: host floats BEFORE the guard window
+        host_metrics_row = {k: float(v) for k, v in metrics.items()}
+        with jax.transfer_guard_device_to_host("disallow"):
+            m, _ = hooks.end_iteration(
+                1, steps_per_iter, state, hk_key, host_metrics_row, None
+            )
+        assert m is not None
+        assert "perf/mfu" in m and "perf/membw_util" in m, sorted(m)
+        assert 0.0 < m["perf/mfu"] < 1.0
+        # and the bare gauge arithmetic is guard-clean in isolation
+        with jax.transfer_guard_device_to_host("disallow"):
+            g = hooks.costs.gauges(hooks.tracer.last_window)
+        assert set(g) <= {"perf/mfu", "perf/membw_util", "perf/flops_per_s"}
+    finally:
+        hooks.close()
+
+
 def test_prefetch_staging_adds_no_device_to_host_syncs(tmp_path):
     """Transfer-guard proof for the dispatch pipeline's staging seam
     (learners/prefetch.py): pulling double-buffered chunks — numpy
