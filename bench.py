@@ -59,36 +59,62 @@ NORTH_STAR = 100_000.0
 # decision so a record can't silently mix tuned and untuned arms
 AUTOTUNE = "off"
 TUNING_CACHE_DIR = None
+# precision-policy arm (surreal_tpu/ops/precision.py): --precision
+# f32|mixed|bf16|bf16_fp8 selects the policy the measured program runs
+# under; the row records it (plus per-iteration FLOPs / bytes accessed
+# from the PR-6 cost accountant) so policy arms can never silently mix.
+# --sweep-precision measures the listed arms back-to-back into one
+# artifact ({"parsed": <headline arm>, "precision": {...}}), and
+# --cost-only skips the timed window (cost model only — how the TRUE
+# headline geometry gets per-policy bytes rows on hosts too slow to time
+# it).
+PRECISION = "mixed"
 # TPU v5e (v5lite) public peak: 197 TFLOP/s bf16 per chip — the MFU
 # denominator. This workload is latency-bound on the env scan, so MFU is
 # an honesty metric (expectedly tiny), not a target.
 PEAK_FLOPS_BF16 = 197e12
 
 
+def _iter_costs(jitted, *args) -> dict | None:
+    """Per-iteration FLOPs + bytes accessed from the PR-6 cost
+    accountant's path (``lower().cost_analysis()`` — host-side trace +
+    HLO cost pass, no compile; the same numbers the driver's
+    ``program_cost`` telemetry events record); None when the backend
+    reports nothing."""
+    from surreal_tpu.session.costs import program_costs
+
+    return program_costs(jitted, *args)
+
+
 def _iter_flops(jitted, *args) -> float | None:
-    """Analytic FLOPs of one compiled training iteration, from XLA's own
-    cost model (compiled.cost_analysis()); None when the backend doesn't
-    report it."""
-    try:
-        ca = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):  # some backends wrap per-device
-            ca = ca[0]
-        return float(ca["flops"]) if ca and "flops" in ca else None
-    except Exception:
-        return None
+    """FLOPs-only view of :func:`_iter_costs` (perf_report.py's
+    attribution harnesses import this)."""
+    costs = _iter_costs(jitted, *args)
+    return costs["flops"] if costs else None
 
 
-def _measure() -> dict:
+def _measure(
+    precision: str | None = None,
+    num_envs: int | None = None,
+    horizon: int | None = None,
+    iters: int | None = None,
+    cost_only: bool = False,
+) -> dict:
     from surreal_tpu.launch.trainer import Trainer
     from surreal_tpu.session.config import Config
     from surreal_tpu.session.default_configs import base_config
 
+    precision = precision or PRECISION
+    num_envs = num_envs or NUM_ENVS
+    horizon = horizon or HORIZON
+    iters = iters or MEASURE_ITERS
     cfg = Config(
         learner_config=Config(
-            algo=Config(name="ppo", horizon=HORIZON, epochs=4,
-                        num_minibatches=4, autotune=AUTOTUNE),
+            algo=Config(name="ppo", horizon=horizon, epochs=4,
+                        num_minibatches=4, autotune=AUTOTUNE,
+                        precision=precision),
         ),
-        env_config=Config(name="jax:lift", num_envs=NUM_ENVS),
+        env_config=Config(name="jax:lift", num_envs=num_envs),
         session_config=Config(
             folder="/tmp/bench_lift",
             tuning_cache_dir=TUNING_CACHE_DIR,
@@ -104,7 +130,33 @@ def _measure() -> dict:
     state = trainer.learner.init(init_key)
     from surreal_tpu.launch.rollout import init_device_carry
 
-    carry = init_device_carry(trainer.env, env_key, NUM_ENVS)
+    carry = init_device_carry(trainer.env, env_key, num_envs)
+
+    result = {
+        "metric": "env_steps_per_sec_per_chip_ppo_fused_blocklift",
+        "unit": "env_steps/s/chip",
+        # the device actually measured: jax can silently fall back to CPU
+        # when the TPU backend fails to init mid-outage, and a CPU number
+        # must never masquerade as the per-chip record
+        "device": str(jax.devices()[0].device_kind),
+        "platform": str(jax.devices()[0].platform),
+        # the active autotuner decision (mode, cache hit/miss, applied
+        # config): a bench record must never silently mix tuned and
+        # untuned arms (surreal_tpu/tune/)
+        "tuning": trainer.tune_decision.artifact(),
+        # the active precision policy + geometry: policy arms must never
+        # silently mix either (ops/precision.py)
+        "precision": precision,
+        "num_envs": num_envs,
+        "horizon": horizon,
+    }
+    costs = _iter_costs(trainer._train_iter, state, carry, key)
+    if costs is not None:
+        result["flops_per_iter"] = costs["flops"]
+        result["bytes_accessed_per_iter"] = costs["bytes_accessed"]
+    if cost_only:
+        result["cost_only"] = True
+        return result
 
     # warmup (compile) -- not measured. device_get, NOT block_until_ready:
     # the latter returns without waiting on this backend (see module doc)
@@ -112,7 +164,6 @@ def _measure() -> dict:
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
     jax.device_get(metrics)
-    flops_per_iter = _iter_flops(trainer._train_iter, state, carry, key)
 
     # throwaway timed window: the first timed window of a freshly
     # compiled program can carry a ~10x one-time tunnel artifact even
@@ -123,34 +174,49 @@ def _measure() -> dict:
     jax.device_get(metrics)
 
     t0 = time.perf_counter()
-    for _ in range(MEASURE_ITERS):
+    for _ in range(iters):
         key, it_key = jax.random.split(key)
         state, carry, metrics = trainer._train_iter(state, carry, it_key)
     jax.device_get(metrics)  # the only trustworthy completion fence
     dt = time.perf_counter() - t0
 
-    steps = MEASURE_ITERS * NUM_ENVS * HORIZON
+    steps = iters * num_envs * horizon
     sps = steps / dt
-    result = {
-        "metric": "env_steps_per_sec_per_chip_ppo_fused_blocklift",
-        "value": round(sps, 1),
-        "unit": "env_steps/s/chip",
-        "vs_baseline": round(sps / NORTH_STAR, 3),
-        # the device actually measured: jax can silently fall back to CPU
-        # when the TPU backend fails to init mid-outage, and a CPU number
-        # must never masquerade as the per-chip record
-        "device": str(jax.devices()[0].device_kind),
-        "platform": str(jax.devices()[0].platform),
-        # the active autotuner decision (mode, cache hit/miss, applied
-        # config): a bench record must never silently mix tuned and
-        # untuned arms (surreal_tpu/tune/)
-        "tuning": trainer.tune_decision.artifact(),
-    }
-    if flops_per_iter is not None:
-        achieved = flops_per_iter * MEASURE_ITERS / dt
+    result["value"] = round(sps, 1)
+    result["vs_baseline"] = round(sps / NORTH_STAR, 3)
+    result["iter_ms"] = round(dt / iters * 1e3, 2)
+    if costs is not None:
+        achieved = costs["flops"] * iters / dt
         result["model_flops_per_s"] = round(achieved, 1)
         result["mfu"] = round(achieved / PEAK_FLOPS_BF16, 6)
     return result
+
+
+def _sweep_precision(
+    num_envs: int | None, horizon: int | None, iters: int | None
+) -> dict:
+    """The precision-policy campaign (ISSUE 7): time the f32 and bf16
+    arms back-to-back at the given geometry, and pull COST-ONLY per-policy
+    rows at the true headline geometry (4096x256 — the accountant's
+    ``lower().cost_analysis()`` needs no timed window, so the bytes
+    comparison stays anchored to the headline workload even on hosts too
+    slow to time it). The bf16 arm is the top-level row (what perf_gate's
+    cross-round fingerprint sees); the f32 arm and the headline cost rows
+    ride under ``precision_sweep`` for the intra-artifact gate."""
+    arms = [
+        _measure(precision=p, num_envs=num_envs, horizon=horizon, iters=iters)
+        for p in ("f32", "mixed", "bf16")
+    ]
+    headline_costs = [
+        _measure(precision=p, cost_only=True)
+        for p in ("f32", "mixed", "bf16")
+    ]
+    headline = dict(arms[-1])  # bf16 is the policy under test
+    headline["precision_sweep"] = {
+        "arms": arms,
+        "headline_costs": headline_costs,
+    }
+    return headline
 
 
 # error signatures of a TPU backend-init outage (the round-5 event: the
@@ -206,7 +272,7 @@ def main() -> int:
         from perf_wallclock import host_path_main
 
         return host_path_main(sys.argv[1:])
-    global AUTOTUNE, TUNING_CACHE_DIR
+    global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]
     if "--tuning-cache" in sys.argv:
@@ -215,10 +281,27 @@ def main() -> int:
         TUNING_CACHE_DIR = os.path.abspath(
             sys.argv[sys.argv.index("--tuning-cache") + 1]
         )
+    if "--precision" in sys.argv:
+        PRECISION = sys.argv[sys.argv.index("--precision") + 1]
+    arg = lambda name, cast, default: (
+        cast(sys.argv[sys.argv.index(name) + 1])
+        if name in sys.argv else default
+    )
+    num_envs = arg("--num-envs", int, None)
+    horizon = arg("--horizon", int, None)
+    iters = arg("--iters", int, None)
+    cost_only = "--cost-only" in sys.argv
+    sweep = "--sweep-precision" in sys.argv
     err = None
     for attempt in range(RETRY_ATTEMPTS):
         try:
-            print(json.dumps(_measure()))
+            if sweep:
+                print(json.dumps(_sweep_precision(num_envs, horizon, iters)))
+            else:
+                print(json.dumps(_measure(
+                    num_envs=num_envs, horizon=horizon, iters=iters,
+                    cost_only=cost_only,
+                )))
             return 0
         except Exception as e:  # noqa: BLE001 — the artifact records it
             err = f"{type(e).__name__}: {e}"

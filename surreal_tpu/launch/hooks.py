@@ -103,9 +103,12 @@ class SessionHooks:
         self.trace_id = self.tracer.trace_id
         # cost/MFU accounting (session/costs.py): drivers register their
         # jitted hot programs via record_program_costs; the perf/* gauges
-        # ride the metrics cadence in end_iteration below
+        # ride the metrics cadence in end_iteration below. The learner's
+        # precision policy stamps every program_cost record so artifacts
+        # carry per-policy rows (ops/precision.py).
         self.costs = CostAccountant(
-            cfg, on_event=self.tracer.event, log=self.log
+            cfg, on_event=self.tracer.event, log=self.log,
+            policy=getattr(learner, "policy", None),
         )
         # persistent XLA compile cache: enabled before the driver's first
         # jitted call compiles (drivers construct hooks inside run(), and
@@ -118,6 +121,14 @@ class SessionHooks:
         self.ckpt: CheckpointManager | None = make_checkpoint_manager(
             cfg, on_event=self.tracer.event
         )
+        # precision: the learner's resolved policy (ops/precision.py) —
+        # recorded into checkpoint run metadata (restore fails loudly on
+        # a policy mismatch), emitted as a 'precision' telemetry event in
+        # begin_run, and rendered by `surreal_tpu diag`'s Performance
+        # section
+        pol = getattr(learner, "policy", None)
+        self.precision = pol
+        self._precision_meta = pol.meta() if pol is not None else None
         self._ckpt_every = PeriodicTracker(max(1, cfg.checkpoint.every_n_iters))
         # robustness layer (ISSUE 5): the preemption sentinel latches
         # SIGTERM/SIGINT and end_iteration turns it into a stop at the
@@ -277,6 +288,14 @@ class SessionHooks:
         folder; restore_from only seeds the very first run."""
         cfg = self.config.session_config.checkpoint
         if cfg.auto_resume and self.ckpt is not None:
+            # precision guard FIRST: a policy mismatch must surface as
+            # the named error, not as orbax's structure traceback from
+            # the restore walk below (session/checkpoint.py). Inside the
+            # auto_resume branch deliberately: a launch that will never
+            # restore (auto_resume=False, fresh training into the same
+            # folder) must not be blocked by the old run's policy —
+            # begin_run then overwrites the sidecar with the new one.
+            self.ckpt.check_precision(self._precision_meta)
             # newest FINITE checkpoint, not merely the newest readable one:
             # in warn mode (multi-host) a poisoned run-end save can exist,
             # and resuming into it would re-trip forever — the walk skips
@@ -293,6 +312,8 @@ class SessionHooks:
                 return state, int(meta["iteration"]), int(meta["env_steps"])
         if cfg.restore_from:
             mgr = CheckpointManager(cfg.restore_from, on_event=self.tracer.event)
+            # same precision guard for foreign warm-starts
+            mgr.check_precision(self._precision_meta)
             restored = mgr.restore(init_state)
             mgr.close()
             if restored is None:
@@ -332,6 +353,22 @@ class SessionHooks:
         )
         self._t0 = time.time()
         self._steps0 = env_steps
+        if self._precision_meta is not None:
+            # the active precision policy: one telemetry event per run
+            # (diag renders it in Performance) + the checkpoint sidecar
+            # restore validates against (written here, BEFORE the first
+            # save, so even a run killed mid-first-interval leaves the
+            # guard in place)
+            self.tracer.event("precision", **self.precision.telemetry())
+            self.log.info(
+                "precision policy: %s",
+                " ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(self.precision.telemetry().items())
+                ),
+            )
+            if self.ckpt is not None:
+                self.ckpt.save_run_metadata(self._precision_meta)
 
     def end_iteration(
         self,

@@ -6,6 +6,11 @@ others, ZMQ between them, SURVEY.md §3.1/§5.8; the rebuild scales the JAX
 way: every host runs THIS SAME program over ONE global device mesh and XLA
 emits ICI collectives within a slice, DCN collectives across hosts).
 
+# precision: dtype-transparent like parallel/dp.py — the precision
+# policy (ops/precision.py) rides inside the learners every rank builds
+# identically from the same config, so replicas stay bitwise-identical
+# under any policy; rank 0's hooks record/validate it.
+
 Per-process discipline (the multi-controller contract):
 
 - **Same program, same seeds.** Every rank derives the identical PRNG key

@@ -32,6 +32,7 @@ from surreal_tpu.learners import build_learner
 from surreal_tpu.learners.aggregator import nstep_transitions
 from surreal_tpu.learners.ddpg import ou_noise_step
 from surreal_tpu.replay import build_replay
+from surreal_tpu.session.config import Config
 from surreal_tpu.utils import faults
 
 
@@ -82,6 +83,15 @@ class OffPolicyTrainer:
             self.learner = build_learner(config.learner_config, self.env.specs)
         algo = self.learner.config.algo
         self.algo = algo
+        # precision: the learner's resolved policy governs replay staging
+        # (storage example dtype below) — one knob for models, learners,
+        # AND replay dtypes (ops/precision.py). replay_gather routes the
+        # ring gather/scatter through the pallas row-DMA kernels (a
+        # searched dimension); injected into the replay build config so
+        # the replay layer stays algo-agnostic.
+        self._replay_build_cfg = Config(
+            gather_impl=algo.get("replay_gather", "xla")
+        ).extend(self.learner.config.replay)
         # searched scan unrolls (tune/space.py); `.get` keeps configs saved
         # before the knobs existed loadable
         self._rollout_unroll = int(algo.get("rollout_unroll", 1))
@@ -110,13 +120,13 @@ class OffPolicyTrainer:
                 dp = self.mesh.shape["dp"]
                 check_dp_divisible(self.num_envs, dp)
                 self.replay = build_replay(
-                    scale_replay_config(self.learner.config.replay, dp)
+                    scale_replay_config(self._replay_build_cfg, dp)
                 )
                 self._train_iter = dp_offpolicy_iter(
                     self._device_train_iter, self.mesh
                 )
             else:
-                self.replay = build_replay(self.learner.config.replay)
+                self.replay = build_replay(self._replay_build_cfg)
                 # donate the loop-carried state / replay shards / env
                 # carry: XLA reuses their HBM (the replay storage is the
                 # program's largest allocation) instead of holding two
@@ -126,7 +136,7 @@ class OffPolicyTrainer:
                     self._device_train_iter, donate_argnums=(0, 1, 2)
                 )
         else:
-            self.replay = build_replay(self.learner.config.replay)
+            self.replay = build_replay(self._replay_build_cfg)
             # acting reuses the same state every env step: never donate
             self._act = jax.jit(
                 self.learner.act, static_argnames="mode", donate_argnums=()
@@ -231,11 +241,19 @@ class OffPolicyTrainer:
         return carry, replay_state
 
     def _replay_example(self) -> dict:
-        """Single-transition example pytree sizing the replay storage."""
+        """Single-transition example pytree sizing the replay storage.
+
+        # precision: obs-class leaves allocate in the policy's staging
+        # dtype (bf16 halves the buffer — the program's LARGEST
+        # allocation; ``ring_insert`` casts incoming f32 rollouts to the
+        # storage dtype). Reward/discount stay f32: the TD target sums
+        # n-step rewards and bf16 accumulation drifts.
+        """
         act_dim = int(self.env.specs.action.shape[0])
+        obs_dtype = jnp.dtype(self.learner.policy.data_dtype)
         return {
-            "obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
-            "next_obs": jnp.zeros(self.env.specs.obs.shape, jnp.float32),
+            "obs": jnp.zeros(self.env.specs.obs.shape, obs_dtype),
+            "next_obs": jnp.zeros(self.env.specs.obs.shape, obs_dtype),
             "action": jnp.zeros((act_dim,), jnp.float32),
             "reward": jnp.zeros((), jnp.float32),
             "discount": jnp.zeros((), jnp.float32),

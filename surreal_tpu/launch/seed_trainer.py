@@ -281,7 +281,10 @@ class SEEDTrainer:
             max_staleness = self.algo.get("max_staleness", None)
         self.max_staleness = max_staleness
 
-        # acting reuses the same state every serve: never donate
+        # acting reuses the same state every serve: never donate.
+        # precision: the learner's resolved policy (ops/precision.py)
+        # lives inside act/learn — SEED's serve path and learn program
+        # need no dtype forks; hooks records/validates the policy
         self._jit_act = jax.jit(
             self.learner.act, static_argnames="mode", donate_argnums=()
         )

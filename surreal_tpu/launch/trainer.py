@@ -62,6 +62,11 @@ class Trainer:
         self.num_envs = config.env_config.num_envs
         self.device_mode = is_jax_env(self.env)
         self.seed = config.session_config.seed
+        # precision: every jitted program below inherits the learner's
+        # resolved policy (ops/precision.py) — model dtypes, SGD staging
+        # casts, and loss scaling all live INSIDE learner.learn/act, so
+        # the trainer needs no dtype forks; hooks records the policy into
+        # checkpoint metadata and telemetry (launch/hooks.py)
 
         if self.device_mode:
             topo = config.session_config.topology

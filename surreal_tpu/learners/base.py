@@ -27,6 +27,11 @@ import jax.numpy as jnp
 import optax
 
 from surreal_tpu.envs.base import EnvSpecs
+from surreal_tpu.ops.precision import (
+    PrecisionPolicy,
+    dynamic_loss_scaling,
+    resolve_policy,
+)
 
 # Agent modes (parity: reference agent modes on surreal/agent/base.py)
 TRAINING = "training"
@@ -97,6 +102,33 @@ def recovery_scale() -> optax.GradientTransformation:
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def make_optimizer_chain(
+    lr, max_grad_norm, policy: PrecisionPolicy
+) -> optax.GradientTransformation:
+    """THE optimizer-chain constructor every learner uses (ppo, impala,
+    and both DDPG chains) — clip -> adam -> recovery_scale, wrapped in
+    dynamic loss scaling when the precision policy asks for it. One
+    builder so a new chain link (or a new policy) cannot be threaded into
+    one algorithm and silently dropped from another.
+
+    # precision: params and optimizer state stay float32 under every
+    # policy; loss scaling wraps the WHOLE chain (ops/precision.py) so an
+    # overflow skips the step without touching Adam moments, and its
+    # state rides the pytree next to recovery_scale — the divergence
+    # guard + rollback remain the second fence behind the skip logic.
+    """
+    inner = optax.chain(
+        optax.clip_by_global_norm(max_grad_norm),
+        optax.adam(lr),
+        # divergence-rollback LR backoff: a no-op scale-by-1 until
+        # launch/recovery.py writes a backed-off value into the state
+        recovery_scale(),
+    )
+    if policy.loss_scaling:
+        return dynamic_loss_scaling(inner, policy)
+    return inner
+
+
 def set_recovery_lr_scale(tree: Any, scale) -> Any:
     """Write ``scale`` into every :class:`RecoveryScaleState` leaf of a
     learner-state pytree (all optimizer chains at once — DDPG carries
@@ -138,6 +170,11 @@ class Learner(abc.ABC):
     def __init__(self, learner_config, env_specs: EnvSpecs):
         self.config = learner_config
         self.specs = env_specs
+        # precision: resolved ONCE at build for every algorithm —
+        # subclasses build models from policy.model_config(...) and
+        # optimizer chains from make_optimizer_chain(...), drivers read
+        # it for staging dtypes and checkpoint metadata (ops/precision.py)
+        self.policy = resolve_policy(learner_config)
         # fail-fast-on-unwired-knobs convention: the trajectory encoder is
         # implemented by PPOLearner (which overrides this flag before it
         # can raise); any other algorithm silently ignoring the knob would

@@ -25,10 +25,11 @@ from surreal_tpu.envs.base import EnvSpecs
 from surreal_tpu.learners.base import (
     TRAINING,
     Learner,
-    recovery_scale,
+    make_optimizer_chain,
     training_health,
 )
 from surreal_tpu.models.ddpg_net import DDPGActor, DDPGCritic
+from surreal_tpu.ops.precision import current_loss_scale, loss_scale_metrics
 from surreal_tpu.ops.running_stats import (
     RunningStats,
     init_stats,
@@ -64,6 +65,12 @@ DDPG_LEARNER_CONFIG = Config(
         # OffPolicyTrainer._device_train_iter). Prioritized replay keeps
         # the sequential path: priorities change between updates.
         batched_uniform_sampling=True,
+        # replay gather implementation for the batched uniform fast path
+        # (a searched autotuner dimension, tune/space.py): 'xla' = one
+        # fused XLA ring gather | 'pallas' = scalar-prefetch gather
+        # kernel (ops/pallas_replay.py; interpret mode off-TPU) — rows
+        # DMA HBM->VMEM exactly once, driven by the index vector
+        replay_gather="xla",
         horizon=16,            # collect chunk length per iteration
         use_layer_norm=True,
     ),
@@ -88,23 +95,26 @@ class DDPGLearner(Learner):
         if env_specs.discrete:
             raise ValueError("DDPG requires a continuous action space")
         self.act_dim = int(env_specs.action.shape[0])
-        model_cfg = learner_config.model.to_dict()
+        # precision: model dtypes materialize from the resolved policy
+        # (Learner.__init__), 'auto' knobs -> concrete per algo.precision
+        model_cfg = self.policy.model_config(learner_config.model)
         self.actor = DDPGActor(model_cfg=model_cfg, act_dim=self.act_dim)
         self.critic = DDPGCritic(
             model_cfg=model_cfg, use_layer_norm=learner_config.algo.use_layer_norm
         )
-        # recovery_scale: divergence-rollback LR backoff (learners/base.py)
-        # — a no-op scale-by-1 until launch/recovery.py backs it off; on
-        # BOTH chains so a rollback slows actor and critic together
-        self.actor_tx = optax.chain(
-            optax.clip_by_global_norm(learner_config.optimizer.max_grad_norm),
-            optax.adam(learner_config.algo.actor_lr),
-            recovery_scale(),
+        # the shared chain builder (learners/base.py): clip -> adam ->
+        # recovery_scale on BOTH chains (a rollback slows actor and critic
+        # together), each wrapped in its OWN dynamic loss scale when the
+        # precision policy asks — the two losses overflow independently
+        self.actor_tx = make_optimizer_chain(
+            learner_config.algo.actor_lr,
+            learner_config.optimizer.max_grad_norm,
+            self.policy,
         )
-        self.critic_tx = optax.chain(
-            optax.clip_by_global_norm(learner_config.optimizer.max_grad_norm),
-            optax.adam(learner_config.algo.critic_lr),
-            recovery_scale(),
+        self.critic_tx = make_optimizer_chain(
+            learner_config.algo.critic_lr,
+            learner_config.optimizer.max_grad_norm,
+            self.policy,
         )
 
     # -- state ---------------------------------------------------------------
@@ -188,6 +198,13 @@ class DDPGLearner(Learner):
         if is_w is None:
             is_w = jnp.ones_like(batch["reward"])
 
+        # precision: each chain carries its OWN dynamic loss scale (1.0
+        # when the policy carries none — ops/precision.py); the scaled
+        # losses differentiate, the chains divide the grads back down and
+        # skip overflowed steps independently
+        c_scale = current_loss_scale(state.critic_opt)
+        a_scale = current_loss_scale(state.actor_opt)
+
         # critic: TD target from target networks
         next_a = self.actor.apply(state.target_actor_params, next_obs)
         q_next = self.critic.apply(state.target_critic_params, next_obs, next_a)
@@ -197,18 +214,23 @@ class DDPGLearner(Learner):
         def critic_loss_fn(critic_params):
             q = self.critic.apply(critic_params, obs, batch["action"])
             td = q - target
-            return (is_w * td**2).mean(), td
+            return (is_w * td**2).mean() * c_scale, td
 
         (c_loss, td), c_grads = jax.value_and_grad(critic_loss_fn, has_aux=True)(
             state.critic_params
         )
+        c_loss = c_loss / c_scale  # report the true loss (pow2 — exact)
 
         # actor: deterministic policy gradient through the live critic
         def actor_loss_fn(actor_params):
             a = self.actor.apply(actor_params, obs)
-            return -(is_w * self.critic.apply(state.critic_params, obs, a)).mean()
+            return (
+                -(is_w * self.critic.apply(state.critic_params, obs, a)).mean()
+                * a_scale
+            )
 
         a_loss, a_grads = jax.value_and_grad(actor_loss_fn)(state.actor_params)
+        a_loss = a_loss / a_scale
 
         if axis_name is not None:
             c_grads = jax.lax.pmean(c_grads, axis_name)
@@ -259,12 +281,20 @@ class DDPGLearner(Learner):
             "loss/actor": a_loss,
             "q/mean_target": target.mean(),
             "q/mean_abs_td": jnp.abs(td).mean(),
-            # one health set over BOTH trees (grads already pmean'd above)
+            # one health set over BOTH trees (grads already pmean'd
+            # above; each tree unscaled by its own power-of-two loss
+            # scale so the norm is the TRUE magnitude — inf/nan survive)
             **training_health(
                 {"actor": state.actor_params, "critic": state.critic_params},
                 {"actor": actor_params, "critic": critic_params},
-                optax.global_norm({"actor": a_grads, "critic": c_grads}),
+                optax.global_norm({
+                    "actor": jax.tree.map(lambda g: g / a_scale, a_grads),
+                    "critic": jax.tree.map(lambda g: g / c_scale, c_grads),
+                }),
             ),
+            # precision: loss-scale telemetry over both chains (empty
+            # when the policy carries no scale)
+            **loss_scale_metrics({"actor": actor_opt, "critic": critic_opt}),
         }
         if axis_name is not None:
             metrics = jax.lax.pmean(metrics, axis_name)

@@ -24,14 +24,15 @@ from surreal_tpu.envs.base import EnvSpecs
 from surreal_tpu.learners.base import (
     TRAINING,
     Learner,
-    recovery_scale,
+    make_optimizer_chain,
     training_health,
 )
 from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
+from surreal_tpu.ops.precision import current_loss_scale, loss_scale_metrics
 from surreal_tpu.ops.running_stats import RunningStats, init_stats, normalize, update_stats
-from surreal_tpu.ops.vtrace import vtrace_nextobs
+from surreal_tpu.ops.vtrace import vtrace_nextobs, vtrace_nextobs_assoc
 from surreal_tpu.session.config import Config
 
 IMPALA_LEARNER_CONFIG = Config(
@@ -44,6 +45,12 @@ IMPALA_LEARNER_CONFIG = Config(
         value_coeff=0.5,
         entropy_coeff=0.01,
         init_log_std=-0.5,    # continuous-action variant
+        # V-trace recurrence implementation (a searched autotuner
+        # dimension, tune/space.py — the per-op kernel twin of PPO's
+        # gae_impl): 'xla' lax.scan | 'assoc' log-depth associative_scan
+        # | 'pallas' fused kernel (ops/pallas_vtrace.py; interpret mode
+        # off-TPU)
+        vtrace_impl="xla",
     ),
     optimizer=Config(lr=6e-4),
     replay=Config(kind="fifo"),
@@ -68,20 +75,24 @@ class IMPALALearner(SequenceActingMixin, Learner):
         enc = learner_config.model.get("encoder", None)
         self.seq_policy = bool(enc is not None and enc.get("kind") == "trajectory")
         self.requires_act_carry = self.seq_policy
+        # precision: model dtypes materialize from the resolved policy
+        # (Learner.__init__), 'auto' knobs -> concrete per algo.precision
+        model_cfg = self.policy.model_config(learner_config.model)
         if self.seq_policy:
             self.model = build_seq_model(
                 learner_config.model, env_specs,
                 learner_config.algo.init_log_std,
                 horizon=learner_config.algo.horizon,
+                policy=self.policy,
             )
         elif self.discrete:
             self.model = CategoricalPPOModel(
-                model_cfg=learner_config.model.to_dict(),
+                model_cfg=model_cfg,
                 n_actions=env_specs.action.n,
             )
         else:
             self.model = PPOModel(
-                model_cfg=learner_config.model.to_dict(),
+                model_cfg=model_cfg,
                 act_dim=int(env_specs.action.shape[0]),
                 init_log_std=learner_config.algo.init_log_std,
             )
@@ -92,12 +103,9 @@ class IMPALALearner(SequenceActingMixin, Learner):
             )
         else:
             lr = opt_cfg.lr
-        self.tx = optax.chain(
-            optax.clip_by_global_norm(opt_cfg.max_grad_norm),
-            optax.adam(lr),
-            # divergence-rollback LR backoff (see learners/base.py)
-            recovery_scale(),
-        )
+        # clip -> adam -> recovery_scale (+ dynamic loss scaling per the
+        # precision policy) — the shared builder, learners/base.py
+        self.tx = make_optimizer_chain(lr, opt_cfg.max_grad_norm, self.policy)
 
     def init(self, key: jax.Array) -> IMPALAState:
         if self.seq_policy:
@@ -153,6 +161,10 @@ class IMPALALearner(SequenceActingMixin, Learner):
         next_obs = self._norm_obs(obs_stats, batch["next_obs"])
 
         T = batch["reward"].shape[0]
+        # precision: dynamic loss scale from the carried opt_state (1.0
+        # when the policy carries none — ops/precision.py); the chain
+        # divides the grads back down and skips overflowed steps
+        loss_scale = current_loss_scale(state.opt_state)
 
         def loss_fn(params):
             if self.seq_policy:
@@ -180,7 +192,7 @@ class IMPALALearner(SequenceActingMixin, Learner):
                 logp = D.diag_gauss_logp(out.mean, out.log_std, batch["action"])
                 entropy = D.diag_gauss_entropy(out.log_std).mean()
 
-            vt = vtrace_nextobs(
+            vt = self._vtrace(
                 behaviour_logp=batch["behavior_logp"],
                 target_logp=jax.lax.stop_gradient(logp),
                 rewards=batch["reward"],
@@ -188,18 +200,11 @@ class IMPALALearner(SequenceActingMixin, Learner):
                 values_next=jax.lax.stop_gradient(values_next),
                 done=batch["done"],
                 terminated=batch["terminated"],
-                gamma=algo.gamma,
-                clip_rho=algo.clip_rho,
-                clip_c=algo.clip_c,
-                clip_pg_rho=algo.clip_pg_rho,
-                # searched recurrence unroll (tune/space.py); clamped in
-                # the op. `.get` keeps pre-knob configs loadable
-                unroll=int(algo.get("gae_unroll", 1)),
             )
             pg_loss = -(vt.pg_advantages * logp).mean()
             v_loss = 0.5 * ((values - vt.vs) ** 2).mean()
             total = pg_loss + algo.value_coeff * v_loss - algo.entropy_coeff * entropy
-            return total, {
+            return total * loss_scale, {
                 "pg_loss": pg_loss,
                 "v_loss": v_loss,
                 "entropy": entropy,
@@ -226,10 +231,49 @@ class IMPALALearner(SequenceActingMixin, Learner):
             "loss/value": aux["v_loss"],
             "policy/entropy": aux["entropy"],
             "policy/rho_mean": aux["rho_mean"],
-            # grads are already pmean'd, so the health scalars replicate
-            **training_health(state.params, params, optax.global_norm(grads)),
+            # grads are already pmean'd, so the health scalars replicate;
+            # the norm is divided by the (power-of-two) loss scale so
+            # health thresholds see the true magnitude — inf/nan survive
+            **training_health(
+                state.params, params, optax.global_norm(grads) / loss_scale
+            ),
+            # precision: loss-scale telemetry (empty when the policy
+            # carries no scale)
+            **loss_scale_metrics(opt_state),
         }
         return new_state, metrics
+
+    def _vtrace(self, **kw):
+        """V-trace with exact truncation handling, routed by
+        ``algo.vtrace_impl`` (the per-op kernel dimension, mirroring
+        PPO's ``gae_impl``): 'xla' reverse lax.scan | 'assoc' log-depth
+        associative_scan | 'pallas' fused VMEM-resident kernel
+        (ops/pallas_vtrace.py; interpret mode off-TPU so the CPU suite
+        covers it)."""
+        algo = self.config.algo
+        clips = dict(
+            gamma=algo.gamma, clip_rho=algo.clip_rho, clip_c=algo.clip_c,
+            clip_pg_rho=algo.clip_pg_rho,
+        )
+        impl = algo.get("vtrace_impl", "xla")
+        if impl == "pallas":
+            from surreal_tpu.ops.pallas_vtrace import vtrace_nextobs_pallas
+
+            return vtrace_nextobs_pallas(
+                **kw, **clips, interpret=jax.default_backend() != "tpu"
+            )
+        if impl == "assoc":
+            return vtrace_nextobs_assoc(**kw, **clips)
+        if impl != "xla":
+            raise ValueError(
+                f"vtrace_impl {impl!r} not in xla|assoc|pallas"
+            )
+        return vtrace_nextobs(
+            **kw, **clips,
+            # searched recurrence unroll (tune/space.py); clamped in the
+            # op. `.get` keeps pre-knob configs loadable
+            unroll=int(algo.get("gae_unroll", 1)),
+        )
 
     def default_config(self):
         return IMPALA_LEARNER_CONFIG

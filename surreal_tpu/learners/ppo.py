@@ -35,9 +35,10 @@ from surreal_tpu.learners.base import (
     EVAL_DETERMINISTIC,
     TRAINING,
     Learner,
-    recovery_scale,
+    make_optimizer_chain,
     training_health,
 )
+from surreal_tpu.ops.precision import current_loss_scale, loss_scale_metrics
 from surreal_tpu.learners.seq_policy import SequenceActingMixin, build_seq_model
 from surreal_tpu.models.ppo_net import CategoricalPPOModel, PPOModel
 from surreal_tpu.ops import distributions as D
@@ -135,20 +136,23 @@ class PPOLearner(SequenceActingMixin, Learner):
         enc = learner_config.model.get("encoder", None)
         self.seq_policy = bool(enc is not None and enc.get("kind") == "trajectory")
         self.requires_act_carry = self.seq_policy
+        # precision: model dtypes materialize from the resolved policy
+        # (Learner.__init__), 'auto' knobs -> concrete per algo.precision
+        model_cfg = self.policy.model_config(learner_config.model)
         if self.seq_policy:
             self.model = build_seq_model(
                 learner_config.model, env_specs, algo.init_log_std,
-                horizon=algo.horizon,
+                horizon=algo.horizon, policy=self.policy,
             )
         elif self.discrete:
             self.model = CategoricalPPOModel(
-                model_cfg=learner_config.model.to_dict(),
+                model_cfg=model_cfg,
                 n_actions=env_specs.action.n,
             )
         else:
             act_dim = int(env_specs.action.shape[0])
             self.model = PPOModel(
-                model_cfg=learner_config.model.to_dict(),
+                model_cfg=model_cfg,
                 act_dim=act_dim,
                 init_log_std=algo.init_log_std,
             )
@@ -161,14 +165,9 @@ class PPOLearner(SequenceActingMixin, Learner):
             )
         else:
             lr = opt_cfg.lr
-        return optax.chain(
-            optax.clip_by_global_norm(opt_cfg.max_grad_norm),
-            optax.adam(lr),
-            # divergence-rollback LR backoff (learners/base.py): a no-op
-            # scale-by-1 until launch/recovery.py writes a backed-off value
-            # into the restored state
-            recovery_scale(),
-        )
+        # clip -> adam -> recovery_scale, wrapped in dynamic loss scaling
+        # when the precision policy stages in bf16 (learners/base.py)
+        return make_optimizer_chain(lr, opt_cfg.max_grad_norm, self.policy)
 
     # -- state ---------------------------------------------------------------
     def init(self, key: jax.Array) -> PPOState:
@@ -269,6 +268,14 @@ class PPOLearner(SequenceActingMixin, Learner):
             flat["b_mean"] = batch["behavior"]["mean"].reshape(N, -1)
             flat["b_log_std"] = batch["behavior"]["log_std"].reshape(N, -1)
 
+        # precision: stage the obs minibatch array in the policy's data
+        # dtype (bf16 under 'bf16'/'bf16_fp8') — the epochs x minibatch
+        # gathers then move half the bytes, at the SAME rounding point
+        # the model's compute-dtype cast would apply per read. The
+        # numerically delicate scalars (logps, advantages, targets) stay
+        # f32 under every policy.
+        flat = self.policy.cast_stage(flat, keys=("obs",))
+
         sgd_out = self._sgd_epochs(
             state, flat, N, algo.num_minibatches, key, axis_name
         )
@@ -331,10 +338,16 @@ class PPOLearner(SequenceActingMixin, Learner):
             )
         return (advantages - adv_mean) / (jnp.sqrt(adv_var) + 1e-8)
 
-    def _loss_fn(self, params, mb, kl_beta, policy_coeff):
+    def _loss_fn(self, params, mb, kl_beta, policy_coeff, loss_scale=1.0):
         """Clipped / adaptive-KL PPO loss. Every reduction is a
         full-tensor mean, so flat [N] minibatches (memoryless path) and
-        [envs, T] segment minibatches (sequence path) share it verbatim."""
+        [envs, T] segment minibatches (sequence path) share it verbatim.
+
+        ``loss_scale`` is the dynamic loss scale read from the CARRIED
+        optimizer state (ops/precision.py) — a power of two multiplying
+        only the differentiated total (aux stays unscaled); the optimizer
+        chain divides the gradients back down and skips overflowed steps.
+        """
         algo = self.config.algo
         out = self.model.apply(params, mb["obs"])
         if self.discrete:
@@ -370,7 +383,7 @@ class PPOLearner(SequenceActingMixin, Learner):
             policy_coeff * (pg_loss - algo.entropy_coeff * entropy)
             + algo.value_coeff * v_loss
         )
-        return total, {
+        return total * loss_scale, {
             "pg_loss": pg_loss,
             "v_loss": v_loss,
             "entropy": entropy,
@@ -434,13 +447,19 @@ class PPOLearner(SequenceActingMixin, Learner):
             params, opt_state, stopped = carry
             mb = jax.tree.map(lambda x: unblock(x[mb_idx]), data)
             policy_coeff = jnp.where(stopped, 0.0, 1.0)
-            grads, aux = grad_fn(params, mb, state.kl_beta, policy_coeff)
+            # precision: the loss scale rides the carried opt_state (a
+            # traced input — scale changes never recompile); 1.0 when the
+            # policy carries no scale
+            scale = current_loss_scale(opt_state)
+            grads, aux = grad_fn(params, mb, state.kl_beta, policy_coeff, scale)
             if axis_name is not None:
                 grads = jax.lax.pmean(grads, axis_name)
                 aux = jax.lax.pmean(aux, axis_name)
             # after the pmean so every replica reports the merged norm;
-            # feeds the health/* diagnostics in _finalize
-            aux["grad_norm"] = optax.global_norm(grads)
+            # feeds the health/* diagnostics in _finalize. Divided by the
+            # loss scale (a power of two — exact) so health thresholds see
+            # the TRUE gradient magnitude; inf/nan survive the division.
+            aux["grad_norm"] = optax.global_norm(grads) / scale
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             stopped = jnp.logical_or(
@@ -518,6 +537,9 @@ class PPOLearner(SequenceActingMixin, Learner):
         metrics.update(
             training_health(state.params, params, auxs["grad_norm"].mean())
         )
+        # precision: loss-scale telemetry (device scalars riding the
+        # metrics cadence); empty dict when the policy carries no scale
+        metrics.update(loss_scale_metrics(opt_state))
         if axis_name is not None:
             # per-shard metrics (explained variance etc.) -> global mean so
             # the replicated out-spec is truthful
@@ -587,6 +609,10 @@ class PPOLearner(SequenceActingMixin, Learner):
         else:
             data["b_mean"] = bt(batch["behavior"]["mean"])
             data["b_log_std"] = bt(batch["behavior"]["log_std"])
+        # precision: same obs-staging cast as the memoryless path (the
+        # trajectory models keep uint8 pixels raw — cast_stage skips
+        # non-float leaves)
+        data = self.policy.cast_stage(data, keys=("obs",))
 
         algo = self.config.algo
         if B // algo.num_minibatches == 0:

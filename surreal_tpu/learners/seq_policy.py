@@ -68,6 +68,7 @@ class SequenceActingMixin(PolicyHeadMixin):
                 self.config.model, self.specs,
                 self.config.algo.init_log_std, mesh=mesh, sp_axis=sp_axis,
                 horizon=self.config.algo.horizon, batch_axis=batch_axis,
+                policy=self.policy,
             )
 
     # -- sequence acting (model.encoder.kind='trajectory') -------------------
@@ -96,8 +97,12 @@ class SequenceActingMixin(PolicyHeadMixin):
                 ),
                 "pos": jnp.zeros((), jnp.int32),
             }
+        # K/V caches live in the policy's compute dtype — the attention
+        # math's own precision, so decode and full-segment recompute
+        # round identically (precision policy, ops/precision.py)
+        kv_dtype = jnp.dtype(self.policy.compute_dtype)
         mk = lambda: jnp.zeros(
-            (num_envs, T, int(enc.num_heads), int(enc.head_dim)), jnp.bfloat16
+            (num_envs, T, int(enc.num_heads), int(enc.head_dim)), kv_dtype
         )
         return {
             "cache": [
@@ -158,13 +163,15 @@ class SequenceActingMixin(PolicyHeadMixin):
 
 def build_seq_model(
     model_config, specs, init_log_std, mesh=None, sp_axis="sp",
-    horizon=None, batch_axis=None,
+    horizon=None, batch_axis=None, policy=None,
 ):
     """Trajectory actor-critic from ``learner_config.model`` — shared by
     every learner that supports ``encoder.kind='trajectory'``. ``horizon``
     (algo.horizon, when the caller has it) is validated against
     ``encoder.max_len``: the extended learn pass runs T+1 positions, so
-    pos_embed must cover horizon+1."""
+    pos_embed must cover horizon+1. ``policy`` is the learner's resolved
+    precision policy (ops/precision.py) supplying the attention compute
+    dtype; None keeps the bf16 default (direct test construction)."""
     from surreal_tpu.models.attention import (
         TrajectoryCategoricalPPOModel,
         TrajectoryPPOModel,
@@ -195,16 +202,17 @@ def build_seq_model(
             f"{specs.obs.shape}"
         )
     enc_cfg = model_config.encoder.to_dict()
+    compute_dtype = jnp.dtype(policy.compute_dtype) if policy else jnp.bfloat16
     if specs.discrete:
         return TrajectoryCategoricalPPOModel(
             encoder_cfg=enc_cfg, n_actions=specs.action.n,
             mesh=mesh, sp_axis=sp_axis, batch_axis=batch_axis,
-            cnn_cfg=cnn_cfg,
+            cnn_cfg=cnn_cfg, compute_dtype=compute_dtype,
         )
     return TrajectoryPPOModel(
         encoder_cfg=enc_cfg,
         act_dim=int(specs.action.shape[0]),
         init_log_std=init_log_std,
         mesh=mesh, sp_axis=sp_axis, batch_axis=batch_axis,
-        cnn_cfg=cnn_cfg,
+        cnn_cfg=cnn_cfg, compute_dtype=compute_dtype,
     )

@@ -247,6 +247,8 @@ class TrajectoryPPOModel(nn.Module):
     sp_axis: str = "sp"
     batch_axis: Any = None
     cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
+    compute_dtype: jnp.dtype = jnp.bfloat16  # precision policy's compute
+                                             # dtype (learners/seq_policy)
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None,
@@ -261,6 +263,7 @@ class TrajectoryPPOModel(nn.Module):
             cnn_cfg=self.cnn_cfg,
             mesh=self.mesh, sp_axis=self.sp_axis,
             batch_axis=self.batch_axis, name="trunk",
+            compute_dtype=self.compute_dtype,
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
             h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)
@@ -295,6 +298,8 @@ class TrajectoryCategoricalPPOModel(nn.Module):
     sp_axis: str = "sp"
     batch_axis: Any = None
     cnn_cfg: Any = None  # model.cnn subtree for PIXEL trajectories
+    compute_dtype: jnp.dtype = jnp.bfloat16  # precision policy's compute
+                                             # dtype (learners/seq_policy)
 
     @nn.compact
     def __call__(self, obs_seq: jax.Array, *, cache=None, pos=None,
@@ -309,6 +314,7 @@ class TrajectoryCategoricalPPOModel(nn.Module):
             cnn_cfg=self.cnn_cfg,
             mesh=self.mesh, sp_axis=self.sp_axis,
             batch_axis=self.batch_axis, name="trunk",
+            compute_dtype=self.compute_dtype,
         )
         if cache is not None:  # incremental acting: obs_seq is [B, obs]
             h, new_cache = trunk(_obs_dtype(obs_seq), cache=cache, pos=pos)

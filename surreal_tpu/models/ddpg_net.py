@@ -9,7 +9,14 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from surreal_tpu.models.encoders import ACTIVATIONS, MLP, make_trunk, orthogonal_init
+from surreal_tpu.models.encoders import (
+    ACTIVATIONS,
+    MLP,
+    _dense_dot_general,
+    concrete_dtype,
+    make_trunk,
+    orthogonal_init,
+)
 
 
 class DDPGActor(nn.Module):
@@ -48,7 +55,10 @@ class DDPGCritic(nn.Module):
     def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
         cfg = self.model_cfg
         act = ACTIVATIONS[cfg["activation"]]
-        compute_dtype = jnp.dtype(cfg["compute_dtype"])
+        # precision policy: learners materialize 'auto' before model
+        # build (ops/precision.py); concrete_dtype covers raw-cfg callers
+        compute_dtype = concrete_dtype(cfg["compute_dtype"], "bfloat16")
+        dot = _dense_dot_general(bool(cfg.get("fp8", False)))
         hidden = tuple(cfg["critic_hidden"])
 
         if cfg["cnn"]["enabled"]:
@@ -60,6 +70,7 @@ class DDPGCritic(nn.Module):
                 kernel_init=orthogonal_init(),
                 dtype=compute_dtype,
                 param_dtype=jnp.float32,
+                dot_general=dot,
             )(h)
             if self.use_layer_norm:
                 h = nn.LayerNorm(dtype=compute_dtype, param_dtype=jnp.float32)(h)
@@ -73,6 +84,7 @@ class DDPGCritic(nn.Module):
                 kernel_init=orthogonal_init(),
                 dtype=compute_dtype,
                 param_dtype=jnp.float32,
+                dot_general=dot,
             )(h)
             if self.use_layer_norm:
                 h = nn.LayerNorm(dtype=compute_dtype, param_dtype=jnp.float32)(h)

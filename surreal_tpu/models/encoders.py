@@ -32,6 +32,17 @@ def orthogonal_init(scale: float = math.sqrt(2.0)):
     return nn.initializers.orthogonal(scale)
 
 
+def _dense_dot_general(use_fp8: bool):
+    """The ``nn.Dense(dot_general=...)`` hook for the experimental fp8
+    matmul path (ops/precision.py::fp8_dot_general): quantize-to-f8 both
+    operands under the 'bf16_fp8' policy, flax's default otherwise."""
+    if not use_fp8:
+        return None
+    from surreal_tpu.ops.precision import fp8_dot_general
+
+    return fp8_dot_general
+
+
 class MLP(nn.Module):
     """Plain MLP trunk with orthogonal init (standard for PPO-family)."""
 
@@ -40,6 +51,7 @@ class MLP(nn.Module):
     compute_dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
     use_layer_norm: bool = False
+    use_fp8: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -51,6 +63,7 @@ class MLP(nn.Module):
                 kernel_init=orthogonal_init(),
                 dtype=self.compute_dtype,
                 param_dtype=self.param_dtype,
+                dot_general=_dense_dot_general(self.use_fp8),
             )(x)
             if self.use_layer_norm:
                 # reference shipped a LayerNorm module used in DDPG nets
@@ -75,6 +88,9 @@ class NatureCNN(nn.Module):
     activation: str = "relu"
     compute_dtype: jnp.dtype = jnp.bfloat16
     param_dtype: jnp.dtype = jnp.float32
+    use_fp8: bool = False  # fp8 applies to the Dense matmul only: conv
+                           # uses conv_general_dilated, which has no
+                           # dot_general hook on this flax pin
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -100,11 +116,23 @@ class NatureCNN(nn.Module):
             kernel_init=orthogonal_init(),
             dtype=self.compute_dtype,
             param_dtype=self.param_dtype,
+            dot_general=_dense_dot_general(self.use_fp8),
         )(x)
         return act(x)
 
 
-def cnn_from_config(cnn_cfg, compute_dtype, param_dtype, name=None) -> NatureCNN:
+def concrete_dtype(value, fallback: str) -> jnp.dtype:
+    """Resolve a model-config dtype knob to a concrete ``jnp.dtype``.
+    Learners materialize 'auto' through the precision policy
+    (ops/precision.py) before model build; this fallback covers direct
+    model construction from raw config trees (tests, tooling) so 'auto'
+    never reaches ``jnp.dtype``."""
+    return jnp.dtype(fallback if value in (None, "auto") else value)
+
+
+def cnn_from_config(
+    cnn_cfg, compute_dtype, param_dtype, name=None, use_fp8: bool = False
+) -> NatureCNN:
     """The one NatureCNN-from-``model.cnn``-subtree constructor — shared
     by the memoryless trunk and the trajectory encoder's per-frame stem,
     so a new cnn config key cannot be honored by one and dropped by the
@@ -116,6 +144,7 @@ def cnn_from_config(cnn_cfg, compute_dtype, param_dtype, name=None) -> NatureCNN
         dense=cnn_cfg["dense"],
         compute_dtype=compute_dtype,
         param_dtype=param_dtype,
+        use_fp8=use_fp8,
         name=name,
     )
 
@@ -127,14 +156,16 @@ def make_trunk(model_cfg, hidden: Sequence[int]) -> nn.Module:
     Item-style access throughout: flax module attributes holding Mappings
     are converted to FrozenDict, which has no attribute access.
     """
-    compute_dtype = jnp.dtype(model_cfg["compute_dtype"])
-    param_dtype = jnp.dtype(model_cfg["dtype"])
+    compute_dtype = concrete_dtype(model_cfg["compute_dtype"], "bfloat16")
+    param_dtype = concrete_dtype(model_cfg["dtype"], "float32")
+    use_fp8 = bool(model_cfg.get("fp8", False))
     cnn = model_cfg["cnn"]
     if cnn["enabled"]:
-        return cnn_from_config(cnn, compute_dtype, param_dtype)
+        return cnn_from_config(cnn, compute_dtype, param_dtype, use_fp8=use_fp8)
     return MLP(
         hidden=tuple(hidden),
         activation=model_cfg["activation"],
         compute_dtype=compute_dtype,
         param_dtype=param_dtype,
+        use_fp8=use_fp8,
     )

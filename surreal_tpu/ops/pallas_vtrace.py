@@ -1,0 +1,190 @@
+"""Fused V-trace as a Pallas TPU kernel — the second member of the
+hot-kernel suite the GAE kernel (ops/pallas_gae.py) opened (ISSUE 7
+tentpole, piece 2; the HEPPO-GAE, arXiv:2501.12703, hardware-pipelined
+recurrence argument applies verbatim: V-trace is the same first-order
+reverse linear recurrence with an importance-weighted delta).
+
+The kernel fuses EVERYTHING the XLA path materializes between HBM round
+trips — rho computation (exp of the log-ratio), the three clip levels,
+the TD deltas, the reverse correction scan, the vs targets, AND the
+pg-advantage tail — into a single VMEM-resident pass per 128-lane batch
+stripe. The pg tail needs ``vs_{t+1}``, which the reverse iteration has
+just computed, so both outputs fall out of ONE loop with a two-slot
+carry (accumulator + successor vs) instead of the XLA path's separate
+shift/concat/select pass.
+
+Entry points (mirroring ops/pallas_gae.py's pair):
+
+- :func:`vtrace_nextobs_pallas` — the truncation-exact two-mask learner
+  form (``ops.vtrace.vtrace_nextobs``'s contract), selected by
+  ``learner_config.algo.vtrace_impl = 'pallas'`` (IMPALA) and searched
+  by the autotuner (tune/space.py).
+- :func:`vtrace_pallas` — drop-in for the simple ``ops.vtrace.vtrace``
+  contract ([T+1] values stack, one discounts array).
+
+Dtype contract: identical to the GAE kernel's — inputs cast to float32,
+float32 outputs, regardless of the precision policy (the recurrence
+accumulates T terms; bf16 accumulation drifts). Callers that want bf16
+downstream cast the outputs.
+
+Runs in interpret mode off-TPU (``interpret=True`` — exact same program,
+no TPU required), which is how the CPU test suite bit-validates it
+against the XLA reference (tests/test_precision.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from surreal_tpu.ops.vtrace import VTraceOutput
+
+_LANES = 128  # VPU lane width; batch stripes tile to this
+
+
+def _vtrace_kernel(
+    bl_ref, tl_ref, r_ref, boot_ref, edge_ref, vt_ref, vn_ref, done_ref,
+    vs_ref, pg_ref,
+    *, T: int, clip_rho: float, clip_c: float, clip_pg_rho: float,
+):
+    """One batch stripe, all refs [T, LANES] f32 in VMEM. Reverse loop
+    carry: (acc = vs_t - V_t accumulator, vs_next = vs_{t+1} — seeded
+    with V(s'_{T-1}), the bootstrap the last step's pg tail uses)."""
+
+    def body(i, carry):
+        acc, vs_next = carry
+        t = T - 1 - i
+        rho = jnp.exp(tl_ref[pl.ds(t, 1), :] - bl_ref[pl.ds(t, 1), :])
+        r = r_ref[pl.ds(t, 1), :]
+        boot = boot_ref[pl.ds(t, 1), :]
+        edge = edge_ref[pl.ds(t, 1), :]
+        v_t = vt_ref[pl.ds(t, 1), :]
+        v_n = vn_ref[pl.ds(t, 1), :]
+        done = done_ref[pl.ds(t, 1), :]
+
+        delta = jnp.minimum(clip_rho, rho) * (r + boot * v_n - v_t)
+        acc = delta + edge * jnp.minimum(clip_c, rho) * acc
+        vs = acc + v_t
+        vs_ref[pl.ds(t, 1), :] = vs
+        # pg tail: the successor's vs, except across an episode boundary
+        # where the successor lives in the next episode — bootstrap off
+        # V(pre-reset terminal obs) instead (ops/vtrace.py's contract)
+        succ = done * v_n + (1.0 - done) * vs_next
+        pg_ref[pl.ds(t, 1), :] = jnp.minimum(clip_pg_rho, rho) * (
+            r + boot * succ - v_t
+        )
+        return acc, vs
+
+    zero = jnp.zeros((1, _LANES), jnp.float32)
+    lax.fori_loop(0, T, body, (zero, vn_ref[pl.ds(T - 1, 1), :]))
+
+
+def _vtrace_call(
+    bl, tl, r, boot, edge, vt, vn, done_mask,
+    clip_rho, clip_c, clip_pg_rho, interpret,
+) -> VTraceOutput:
+    """Pad the batch to 128 lanes and run the kernel: the shared body of
+    both public contracts (they differ only in how boot/edge/done are
+    built). All arrays [T, B] float32."""
+    T, B = r.shape
+    arrs = [bl, tl, r, boot, edge, vt, vn, done_mask]
+    pad = (-B) % _LANES
+    if pad:
+        arrs = [jnp.pad(x, ((0, 0), (0, pad))) for x in arrs]
+    Bp = B + pad
+
+    kernel = functools.partial(
+        _vtrace_kernel, T=T,
+        clip_rho=float(clip_rho), clip_c=float(clip_c),
+        clip_pg_rho=float(clip_pg_rho),
+    )
+    stripe = lambda j: (0, j)  # block index along the batch grid
+    vs, pg = pl.pallas_call(
+        kernel,
+        grid=(Bp // _LANES,),
+        in_specs=[pl.BlockSpec((T, _LANES), stripe)] * 8,
+        out_specs=[
+            pl.BlockSpec((T, _LANES), stripe),
+            pl.BlockSpec((T, _LANES), stripe),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((T, Bp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*arrs)
+    return VTraceOutput(
+        vs=lax.stop_gradient(vs[:, :B]),
+        pg_advantages=lax.stop_gradient(pg[:, :B]),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("gamma", "clip_rho", "clip_c", "clip_pg_rho", "interpret"),
+)
+def vtrace_nextobs_pallas(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    values_next: jax.Array,
+    done: jax.Array,
+    terminated: jax.Array,
+    gamma: float,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+    clip_pg_rho: float = 1.0,
+    interpret: bool = False,
+) -> VTraceOutput:
+    """Truncation-exact V-trace, all inputs [T, B] (the
+    ``ops.vtrace.vtrace_nextobs`` contract: bootstrap discount
+    ``gamma*(1-terminated)`` against V(pre-reset successor obs), the
+    cross-step correction cut at every ``done``), as one fused Pallas
+    pass. ``interpret=True`` runs the identical program off-TPU (how the
+    CPU suite bit-validates it)."""
+    f32 = lambda x: x.astype(jnp.float32)
+    done_f = f32(done)
+    return _vtrace_call(
+        f32(behaviour_logp), f32(target_logp), f32(rewards),
+        gamma * (1.0 - f32(terminated)),   # boot: TD-delta discount
+        gamma * (1.0 - done_f),            # edge: recursion coefficient
+        f32(values), f32(values_next), done_f,
+        clip_rho, clip_c, clip_pg_rho, interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("clip_rho", "clip_c", "clip_pg_rho", "interpret"),
+)
+def vtrace_pallas(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    discounts: jax.Array,
+    values: jax.Array,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+    clip_pg_rho: float = 1.0,
+    interpret: bool = False,
+) -> VTraceOutput:
+    """Drop-in for :func:`ops.vtrace.vtrace` (the simple [T+1]-values
+    contract) as one fused Pallas pass: ``discounts`` serves as both the
+    TD-delta discount and the recursion coefficient base, the done mask
+    is zero (the pg tail always chains through ``vs_{t+1}``), and the
+    carry seeds with ``values[T]`` — the reference's final-step
+    bootstrap."""
+    f32 = lambda x: x.astype(jnp.float32)
+    disc = f32(discounts)
+    zeros = jnp.zeros_like(disc)
+    return _vtrace_call(
+        f32(behaviour_logp), f32(target_logp), f32(rewards),
+        disc, disc,
+        f32(values[:-1]), f32(values[1:]), zeros,
+        clip_rho, clip_c, clip_pg_rho, interpret,
+    )

@@ -170,3 +170,47 @@ def vtrace_nextobs(
     return VTraceOutput(
         vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages)
     )
+
+
+def vtrace_nextobs_assoc(
+    behaviour_logp: jax.Array,
+    target_logp: jax.Array,
+    rewards: jax.Array,
+    values: jax.Array,
+    values_next: jax.Array,
+    done: jax.Array,
+    terminated: jax.Array,
+    gamma: float,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+    clip_pg_rho: float = 1.0,
+) -> VTraceOutput:
+    """:func:`vtrace_nextobs` via ``associative_scan`` — O(log T) depth.
+
+    Same recurrence shared with GAE's assoc path
+    (``ops.returns.reverse_linear_scan_assoc``): the per-step coefficient
+    is ``gamma * (1 - done) * c_t``, the additive term the clipped TD
+    delta. Selected by ``algo.vtrace_impl='assoc'`` (the dispatch-latency
+    pick, mirroring PPO's ``gae_impl='assoc'``).
+    """
+    from surreal_tpu.ops.returns import reverse_linear_scan_assoc
+
+    log_rhos = target_logp - behaviour_logp
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+
+    boot_disc = gamma * (1.0 - terminated.astype(rewards.dtype))
+    edge = 1.0 - done.astype(rewards.dtype)
+    deltas = clipped_rhos * (rewards + boot_disc * values_next - values)
+    vs = reverse_linear_scan_assoc(gamma * edge * cs, deltas) + values
+
+    vs_shift = jnp.concatenate([vs[1:], values_next[-1:]], axis=0)
+    done_f = done.astype(rewards.dtype)
+    vs_next = done_f * values_next + (1.0 - done_f) * vs_shift
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    pg_advantages = clipped_pg_rhos * (rewards + boot_disc * vs_next - values)
+
+    return VTraceOutput(
+        vs=lax.stop_gradient(vs), pg_advantages=lax.stop_gradient(pg_advantages)
+    )

@@ -8,6 +8,12 @@ gradients / obs-stats / advantage moments so replicas stay bitwise
 identical. The same wrapper drives the fused rollout+learn step, sharding
 the env-state pytree so each device steps its own slice of envs — actors
 and learner in one XLA program.
+
+# precision: dtype-transparent by design — the precision policy
+# (ops/precision.py) lives inside learner.learn (model dtypes, staging
+# casts, loss scaling), and shard_map/psum operate on whatever dtypes
+# the learner produces; grads psum in f32 because params are f32 under
+# every policy.
 """
 
 from __future__ import annotations

@@ -65,8 +65,25 @@ def ring_insert(state: RingState, batch: Any, capacity: int) -> RingState:
     )
 
 
-def ring_gather(state: RingState, idx: jax.Array) -> Any:
-    """Gather transitions at ``idx`` -> {k: [B, ...]}."""
+def ring_gather(state: RingState, idx: jax.Array, impl: str = "xla") -> Any:
+    """Gather transitions at ``idx`` -> {k: [B, ...]}.
+
+    ``impl`` routes the data movement (``algo.replay_gather`` — a
+    searched autotuner dimension, tune/space.py): 'xla' = the fused XLA
+    gather; 'pallas' = the scalar-prefetch row-DMA kernel
+    (ops/pallas_replay.py; interpret mode off-TPU). Bit-equal outputs
+    either way — the kernel copies rows verbatim.
+    """
+    if impl == "pallas":
+        from surreal_tpu.ops.pallas_replay import gather_rows_pallas
+
+        interp = jax.default_backend() != "tpu"
+        return jax.tree.map(
+            lambda buf: gather_rows_pallas(buf, idx, interpret=interp),
+            state.storage,
+        )
+    if impl != "xla":
+        raise ValueError(f"replay gather impl {impl!r} not in xla|pallas")
     return jax.tree.map(lambda buf: buf[idx], state.storage)
 
 

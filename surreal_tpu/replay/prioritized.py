@@ -44,6 +44,10 @@ class PrioritizedReplay:
         self.alpha = float(replay_config.priority_alpha)
         self.beta0 = float(replay_config.priority_beta0)
         self.eps = float(replay_config.priority_eps)
+        # replay gather/scatter routing (see replay/uniform.py's note):
+        # 'pallas' also routes the priority-refresh scatter through the
+        # row-DMA kernel. `.get` keeps raw replay configs loadable.
+        self.gather_impl = replay_config.get("gather_impl", "xla")
 
     def init(self, example_transition: Any) -> PrioritizedState:
         return PrioritizedState(
@@ -92,7 +96,7 @@ class PrioritizedReplay:
         weights = (n * jnp.maximum(probs, 1e-12)) ** (-beta)
         weights = weights / jnp.maximum(weights.max(), 1e-12)
 
-        batch = ring_gather(state.ring, idx)
+        batch = ring_gather(state.ring, idx, impl=self.gather_impl)
         return state, batch, {"idx": idx, "is_weights": weights}
 
     # -- telemetry gauges (device scalars; see replay/base.py) ---------------
@@ -110,8 +114,22 @@ class PrioritizedReplay:
         self, state: PrioritizedState, idx: jax.Array, td_errors: jax.Array
     ) -> PrioritizedState:
         prio = jnp.abs(td_errors) + self.eps
+        if self.gather_impl == "pallas":
+            # scalar-prefetch row-DMA scatter (ops/pallas_replay.py),
+            # in-place via input_output_aliases. Duplicate indices (a
+            # stratified draw can repeat a high-mass slot) resolve
+            # last-write-wins in grid order — the same "some write wins"
+            # contract ``.at[].set`` documents as unspecified.
+            from surreal_tpu.ops.pallas_replay import scatter_rows_pallas
+
+            priorities = scatter_rows_pallas(
+                state.priorities, idx, prio,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            priorities = state.priorities.at[idx].set(prio)
         return PrioritizedState(
             ring=state.ring,
-            priorities=state.priorities.at[idx].set(prio),
+            priorities=priorities,
             max_priority=jnp.maximum(state.max_priority, prio.max()),
         )

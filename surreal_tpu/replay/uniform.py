@@ -26,6 +26,11 @@ class UniformReplay:
         self.capacity = int(replay_config.capacity)
         self.batch_size = int(replay_config.batch_size)
         self.start_sample_size = int(replay_config.start_sample_size)
+        # replay-gather routing ('xla' | 'pallas' — the scalar-prefetch
+        # row-DMA kernel, ops/pallas_replay.py); injected from
+        # algo.replay_gather by the off-policy trainer, a searched
+        # autotuner dimension. `.get` keeps raw replay configs loadable.
+        self.gather_impl = replay_config.get("gather_impl", "xla")
 
     def init(self, example_transition: Any) -> RingState:
         return init_ring(example_transition, self.capacity)
@@ -41,7 +46,7 @@ class UniformReplay:
         current fill; size is traced, so indices are ``randint % size``."""
         bs = batch_size or self.batch_size
         idx = jax.random.randint(key, (bs,), 0, jnp.maximum(state.size, 1))
-        batch = ring_gather(state, idx)
+        batch = ring_gather(state, idx, impl=self.gather_impl)
         return state, batch, {"idx": idx}
 
     def sample_many(
@@ -65,7 +70,9 @@ class UniformReplay:
         idx = jax.vmap(
             lambda k: jax.random.randint(k, (bs,), 0, jnp.maximum(state.size, 1))
         )(keys)                                     # [K, bs]
-        flat = ring_gather(state, idx.reshape(-1))  # one gather for all sets
+        # one gather for all sets (impl-routed: 'pallas' turns it into
+        # K*bs scalar-prefetch row DMAs — see ring_gather)
+        flat = ring_gather(state, idx.reshape(-1), impl=self.gather_impl)
         batches = jax.tree.map(
             lambda x: x.reshape(K, bs, *x.shape[1:]), flat
         )

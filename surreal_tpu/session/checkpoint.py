@@ -28,6 +28,49 @@ import jax
 import orbax.checkpoint as ocp
 
 
+class PrecisionMismatchError(ValueError):
+    """A checkpoint was saved under a different precision policy than the
+    one trying to restore it.
+
+    The policy decides whether a ``LossScaleState`` leaf lives in the
+    optimizer pytree (ops/precision.py) and which dtypes the trained
+    numerics used — restoring across a mismatch either fails as a cryptic
+    orbax structure error or, worse, silently resumes f32-trained
+    numerics under a different policy. This error names both policies and
+    the fix instead (the PR-5 ``recovery_scale`` pytree-break lesson,
+    made a first-class check)."""
+
+
+def check_precision_metadata(recorded: dict | None, active: dict | None) -> None:
+    """Raise :class:`PrecisionMismatchError` when a checkpoint's recorded
+    precision metadata disagrees with the active policy. Missing metadata
+    (pre-ISSUE-7 sessions) or an unknown active policy passes — the guard
+    never blocks legacy restores, it explains the breaks that WOULD
+    happen."""
+    if not recorded or not active:
+        return
+    mismatched = {
+        k: (recorded.get(k), active.get(k))
+        for k in (
+            "policy", "param_dtype", "loss_scaling", "compute_dtype",
+            "data_dtype", "fp8",
+        )
+        if k in recorded and recorded.get(k) != active.get(k)
+    }
+    if mismatched:
+        detail = ", ".join(
+            f"{k}: checkpoint={a!r} vs active={b!r}"
+            for k, (a, b) in sorted(mismatched.items())
+        )
+        raise PrecisionMismatchError(
+            "checkpoint was saved under a different precision policy "
+            f"({detail}). Set algo.precision (and optimizer.loss_scaling) "
+            "to match the checkpoint to resume it, or point "
+            "session.folder at a fresh directory to train under the new "
+            "policy from scratch."
+        )
+
+
 class CheckpointManager:
     """Save/restore learner state with keep-last-N + keep-best retention."""
 
@@ -73,6 +116,10 @@ class CheckpointManager:
         )
         self._best_dir = os.path.join(self.directory, "best")
         self._best_meta_path = os.path.join(self.directory, "best_metric.json")
+        # run-scoped metadata sidecar (precision policy etc.): one file
+        # per checkpoint root, not per step — the policy is a build-time
+        # constant of the session writing here
+        self._run_meta_path = os.path.join(self.directory, "run_meta.json")
         self._best_ckptr = ocp.StandardCheckpointer(
             multiprocessing_options=mp_options
         )
@@ -147,6 +194,35 @@ class CheckpointManager:
             return None
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
         return self._extra().restore(step, args=ocp.args.StandardRestore(abstract))
+
+    # -- run metadata (precision policy sidecar) -----------------------------
+    def save_run_metadata(self, meta: dict) -> None:
+        """Persist run-scoped metadata (the active precision policy —
+        ops/precision.py ``PrecisionPolicy.meta()``) beside the step dirs.
+        Atomic (tmp + rename): relaunch pollers race this write."""
+        tmp = self._run_meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._run_meta_path)
+
+    def run_metadata(self) -> dict | None:
+        """The recorded run metadata, or None (pre-ISSUE-7 sessions /
+        torn writes read as absent — the guard must never turn a legacy
+        resume into a crash about metadata bookkeeping)."""
+        if not os.path.exists(self._run_meta_path):
+            return None
+        try:
+            with open(self._run_meta_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def check_precision(self, active_meta: dict | None) -> None:
+        """Fail restore LOUDLY on a precision-policy mismatch (see
+        :class:`PrecisionMismatchError`); callers run this BEFORE orbax
+        touches the step dirs so the user sees the policy diff, not a
+        structure traceback."""
+        check_precision_metadata(self.run_metadata(), active_meta)
 
     # -- restore -------------------------------------------------------------
     def latest_step(self) -> int | None:

@@ -190,7 +190,12 @@ class CostAccountant:
     snapshots into ``Tracer.last_window``) into ``perf/*`` host floats.
     """
 
-    def __init__(self, session_cfg, on_event=None, log=None):
+    def __init__(self, session_cfg, on_event=None, log=None, policy=None):
+        # policy: the learner's resolved PrecisionPolicy (ops/precision.py)
+        # — stamped into every program_cost record/event so committed
+        # artifacts carry bytes/MFU rows PER PRECISION POLICY, never
+        # silently mixed across policy arms
+        self.policy = policy
         self._cfg = session_cfg
         self.enabled = True
         perf = session_cfg.get("perf", None) if session_cfg is not None else None
@@ -265,6 +270,8 @@ class CostAccountant:
             "calls_per_phase": int(calls_per_phase),
             **costs,
         }
+        if self.policy is not None:
+            rec["precision"] = getattr(self.policy, "name", str(self.policy))
         if self._memory_analysis_ok():
             mem = program_memory(jitted, *args, **kwargs)
             if mem is not None:

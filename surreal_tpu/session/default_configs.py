@@ -38,6 +38,21 @@ BASE_LEARNER_CONFIG = Config(
         rollout_unroll=1,  # device rollout scan over the horizon
         gae_unroll=1,      # time recurrences: PPO's xla GAE scan,
                            # IMPALA's V-trace scan, ops/returns estimators
+        # precision policy (ops/precision.py) — ONE knob governing model
+        # compute dtype, trajectory/SGD/replay staging dtype, and dynamic
+        # loss scaling, threaded through every learner and trainer (and a
+        # searched autotuner dimension, tune/space.py):
+        #   'f32'      compute f32, staging f32 (numerics baseline)
+        #   'mixed'    compute bf16, staging f32 (the pre-ISSUE-7 default
+        #              — kept default so existing configs/checkpoints
+        #              reproduce exactly; no loss-scale state in the
+        #              optimizer pytree)
+        #   'bf16'     compute bf16 AND staging bf16 (obs-class arrays
+        #              move half the bytes) + dynamic loss scaling
+        #   'bf16_fp8' 'bf16' plus the experimental fp8 matmul path in
+        #              Dense layers — behind this knob only, never
+        #              auto-searched
+        precision="mixed",
     ),
     model=Config(
         actor_hidden=(64, 64),
@@ -71,14 +86,36 @@ BASE_LEARNER_CONFIG = Config(
             strides=(4, 2, 1),
             dense=512,
         ),
-        dtype="float32",           # parameters; compute may be bfloat16
-        compute_dtype="bfloat16",  # MXU-friendly activations dtype
+        # 'auto' resolves BOTH dtypes from algo.precision (the unified
+        # policy knob above — ops/precision.py); an explicit dtype string
+        # here overrides the policy for this model alone (the pre-ISSUE-7
+        # spelling, kept honored for old configs)
+        dtype="auto",           # parameter dtype ('auto' -> float32)
+        compute_dtype="auto",   # activations dtype ('auto' -> per policy)
     ),
     optimizer=Config(
         name="adam",
         lr=3e-4,
         max_grad_norm=0.5,
         lr_schedule="constant",  # 'constant' | 'linear'
+        # dynamic loss scaling (ops/precision.py::dynamic_loss_scaling):
+        # 'auto' enables it exactly when the precision policy stages in
+        # bf16 ('bf16'/'bf16_fp8'); True/False force it. All factors are
+        # powers of two, so scaling is exact on healthy steps; an
+        # overflow skips the step (Adam moments untouched) and backs the
+        # scale off. NOTE: enabling adds a LossScaleState leaf to the
+        # optimizer pytree — checkpoints do not restore across a
+        # loss-scaling flip (the run-metadata guard makes that a clear
+        # error, session/checkpoint.py).
+        loss_scaling=Config(
+            enabled="auto",
+            init=2.0**15,
+            growth_interval=2000,
+            growth_factor=2.0,
+            backoff_factor=0.5,
+            min=1.0,
+            max=2.0**24,
+        ),
     ),
     replay=Config(
         kind="fifo",    # 'fifo' | 'uniform' | 'prioritized' (algo defaults override)

@@ -64,6 +64,12 @@ line, ``t`` = unix seconds):
      "peak_flops": ..., "peak_membw": ..., ...}
                     (cost/MFU accounting, session/costs.py: one per
                      registered hot program, recorded at driver startup)
+    {"type": "precision", "t": ..., "policy": "f32|mixed|bf16|bf16_fp8",
+     "compute_dtype": "...", "data_dtype": "...", "loss_scaling": ...,
+     "fp8": ...}
+                    (the active precision policy, ops/precision.py —
+                     emitted once per run by SessionHooks.begin_run;
+                     diag's Performance section leads with it)
     {"type": "hops", "t": ..., "<hop>_ms": {"p50": ..., "p90": ...,
      "p99": ..., "n": N}, ...}
                     (per-hop latency percentiles of the SEED
@@ -363,6 +369,7 @@ def diag_summary(folder: str) -> dict | None:
     data_plane = None
     trace_id = None
     programs: dict[str, dict] = {}   # program_cost events (last per name)
+    precision = None                 # last 'precision' event (active policy)
     perf_last: dict[str, float] = {}  # perf/* gauges from the last row
     hops = None                      # last 'hops' event's percentiles
     profiles: list[dict] = []        # 'profile' capture events
@@ -431,6 +438,12 @@ def diag_summary(folder: str) -> dict | None:
         elif ev.get("type") == "program_cost":
             name = str(ev.get("name", "?"))
             programs[name] = {
+                k: v for k, v in ev.items()
+                if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "precision":
+            # last event wins (one per run; a resumed session re-emits)
+            precision = {
                 k: v for k, v in ev.items()
                 if k not in ("type", "t", "trace", "seq")
             }
@@ -523,6 +536,7 @@ def diag_summary(folder: str) -> dict | None:
         "nonfinite_windows": nonfinite_windows,
         "heartbeats": heartbeats,
         "programs": programs,
+        "precision": precision,
         "perf": perf_last,
         "hops": hops,
         "profiles": profiles,
@@ -695,10 +709,23 @@ def _performance_lines(s: dict) -> list[str]:
     percentiles (the stitched cross-process timeline), and captured
     profiler traces. Empty list when the session recorded none of them."""
     progs = s.get("programs") or {}
+    prec = s.get("precision") or {}
     perf = s.get("perf") or {}
     hops = s.get("hops") or {}
     profiles = s.get("profiles") or []
     lines: list[str] = []
+    if prec:
+        # the active precision policy leads: every roofline number below
+        # was produced under it (ops/precision.py)
+        lines.append(
+            f"  precision policy: {prec.get('policy', '?')} "
+            f"(compute {prec.get('compute_dtype', '?')}, "
+            f"staging {prec.get('data_dtype', '?')}, params "
+            f"{prec.get('param_dtype', 'float32')}, loss scaling "
+            + ("on" if prec.get("loss_scaling") else "off")
+            + (", fp8 matmuls" if prec.get("fp8") else "")
+            + ")"
+        )
     if progs:
         any_rec = next(iter(progs.values()))
         kind = any_rec.get("device_kind", "?")

@@ -25,6 +25,9 @@ TUNABLE_KEYS = (
     "gae_unroll",
     "gae_impl",
     "shuffle",
+    "precision",      # the precision policy (ops/precision.py)
+    "vtrace_impl",    # IMPALA's per-op V-trace kernel choice
+    "replay_gather",  # DDPG's batched replay gather/scatter impl
 )
 _EXCLUDED = TUNABLE_KEYS + ("autotune",)
 
@@ -50,17 +53,38 @@ def fingerprint_dict(
         for k, v in extended_learner_config.algo.to_dict().items()
         if k not in _EXCLUDED
     }
+    model = (
+        extended_learner_config.model.to_dict()
+        if "model" in extended_learner_config
+        else {}
+    )
+    # 'auto' dtypes resolve FROM the searched precision knob
+    # (ops/precision.py) — hashing them as the policy's concrete values
+    # would leak the excluded knob back into the key, and hashing the
+    # literal 'auto' would invalidate every pre-PR-7 cache entry. Both
+    # canonicalize to the pre-policy defaults; an EXPLICIT dtype string
+    # changes the program independently of the search and hashes as
+    # itself.
+    if model.get("dtype") in (None, "auto"):
+        model["dtype"] = "float32"
+    if model.get("compute_dtype") in (None, "auto"):
+        model["compute_dtype"] = "bfloat16"
+    optimizer = (
+        extended_learner_config.optimizer.to_dict()
+        if "optimizer" in extended_learner_config
+        else {}
+    )
+    # the loss_scaling subtree is part of the precision-policy axis the
+    # fingerprint deliberately excludes (its effect follows algo.precision,
+    # and healthy-step numerics are exact either way — power-of-two scales)
+    optimizer.pop("loss_scaling", None)
     fp = {
         "algo": algo,
-        "model": extended_learner_config.model.to_dict()
-        if "model" in extended_learner_config
-        else {},
+        "model": model,
         "replay": extended_learner_config.replay.to_dict()
         if "replay" in extended_learner_config
         else {},
-        "optimizer": extended_learner_config.optimizer.to_dict()
-        if "optimizer" in extended_learner_config
-        else {},
+        "optimizer": optimizer,
         "env": {
             "name": env_config.name,
             "num_envs": int(env_config.get("num_envs", 1)),

@@ -39,8 +39,13 @@ MIN_GAIN = 0.02  # adoption threshold vs the incumbent (noise floor)
 # surface for HOST-env workloads (gym/dm_control/SEED), whose rollout is
 # host python with no device scan to unroll. The learn program is a
 # device computation regardless of where the envs live, so these knobs
-# are measurable (and cacheable) for host fingerprints too.
-LEARN_PHASE_DIMS = ("gae_impl", "gae_unroll", "sgd_unroll", "shuffle")
+# are measurable (and cacheable) for host fingerprints too. precision
+# and vtrace_impl qualify: the policy's dtypes and the V-trace kernel
+# both live inside the jitted learn.
+LEARN_PHASE_DIMS = (
+    "gae_impl", "gae_unroll", "sgd_unroll", "shuffle",
+    "precision", "vtrace_impl",
+)
 
 
 def search_space_for(config, extended_learner_config) -> list[tuple[str, list]]:
@@ -51,6 +56,13 @@ def search_space_for(config, extended_learner_config) -> list[tuple[str, list]]:
     from a host loop) — callers treat that as 'stay on defaults'."""
     space = candidate_space(extended_learner_config)
     if not str(config.env_config.name).startswith("jax:"):
+        if extended_learner_config.algo.name == "ddpg":
+            # host-env DDPG stays unsearchable even though 'precision'
+            # is a learn-phase dim: its update loop runs as individual
+            # jitted learns over n-step REPLAY batches, which the
+            # synthetic learn-batch harness (_synthetic_learn_batch,
+            # PPO/IMPALA trajectory contract) cannot fabricate
+            return []
         space = [(n, v) for n, v in space if n in LEARN_PHASE_DIMS]
     return space
 

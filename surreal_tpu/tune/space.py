@@ -20,6 +20,17 @@ PR: geometry knobs are searchable dimensions, not hand-tuned constants):
   scan (launch/offpolicy_trainer.py).
 - ``shuffle`` — PPO minibatch layout: 'block' (contiguous-block permute,
   the measured TPU default) | 'row' (exact reference semantics).
+- ``precision`` — the precision policy (ops/precision.py): 'f32' |
+  'mixed' | 'bf16'. Searched FIRST: it is the biggest lever and every
+  later unroll choice should be measured under the adopted policy. The
+  experimental 'bf16_fp8' is deliberately NOT in the space — numerics
+  experiments stay behind an explicit knob, never a timing search.
+- ``vtrace_impl`` — IMPALA's V-trace recurrence: 'xla' | 'assoc' |
+  'pallas' (ops/pallas_vtrace.py) — the per-op kernel twin of
+  ``gae_impl``.
+- ``replay_gather`` — DDPG's batched-uniform replay data movement:
+  'xla' fused gather | 'pallas' scalar-prefetch row-DMA kernel
+  (ops/pallas_replay.py).
 
 New geometry knobs join the search by adding a dimension here plus the
 key to fingerprint.TUNABLE_KEYS.
@@ -36,6 +47,8 @@ def candidate_space(extended_learner_config) -> list[tuple[str, list]]:
     name = algo.name
     horizon = int(algo.get("horizon", 1))
     dims: list[tuple[str, list]] = [
+        # precision first: later dims re-measure under the adopted policy
+        ("precision", ["f32", "mixed", "bf16"]),
         ("rollout_unroll", [u for u in (1, 2, 4, 8) if u <= horizon]),
     ]
     if name == "ppo":
@@ -45,11 +58,20 @@ def candidate_space(extended_learner_config) -> list[tuple[str, list]]:
         dims.append(("sgd_unroll", [u for u in (1, 2, 4) if u <= num_mb]))
         dims.append(("shuffle", ["block", "row"]))
     elif name == "impala":
-        # V-trace recurrence unroll (the learn-phase scan IMPALA has)
+        # the per-op V-trace kernel choice, then its xla-path unroll
+        dims.append(("vtrace_impl", ["xla", "assoc", "pallas"]))
         dims.append(("gae_unroll", [u for u in (1, 2, 4) if u <= horizon]))
     elif name == "ddpg":
         upd = int(algo.get("updates_per_iter", 1))
         dims.append(("update_unroll", [u for u in (1, 2, 4, 8) if u <= upd]))
+        replay = extended_learner_config.get("replay", None)
+        if (
+            bool(algo.get("batched_uniform_sampling", True))
+            and replay is not None
+            and replay.get("kind") == "uniform"
+        ):
+            # the batched gather exists only on the uniform fast path
+            dims.append(("replay_gather", ["xla", "pallas"]))
     return [(n, vals) for n, vals in dims if len(vals) > 1]
 
 
@@ -66,12 +88,20 @@ def default_point(extended_learner_config) -> dict:
 
 def skip_dimension(name: str, incumbent: dict, extended_learner_config) -> bool:
     """Prune dimensions made moot by the incumbent: ``gae_unroll`` only
-    exists inside PPO's 'xla' lax.scan path — under 'assoc'/'pallas' every
-    candidate compiles the identical program."""
+    exists inside the 'xla' lax.scan path — under 'assoc'/'pallas' every
+    candidate compiles the identical program (PPO's gae_impl; IMPALA's
+    vtrace_impl is the same story for its recurrence)."""
+    algo_name = extended_learner_config.algo.name
     if (
         name == "gae_unroll"
-        and extended_learner_config.algo.name == "ppo"
+        and algo_name == "ppo"
         and incumbent.get("gae_impl", "xla") != "xla"
+    ):
+        return True
+    if (
+        name == "gae_unroll"
+        and algo_name == "impala"
+        and incumbent.get("vtrace_impl", "xla") != "xla"
     ):
         return True
     return False

@@ -205,6 +205,66 @@ def test_hot_scans_declare_unroll():
     )
 
 
+_PRECISION_MARKERS = ("# precision:", "ops.precision import", "ops import precision")
+
+
+def test_jitted_steps_declare_precision():
+    """Precision-discipline lint (ISSUE 7 satellite, mirror of the
+    donation/unroll lints): every learner/trainer step module that builds
+    a ``jax.jit`` hot program must STATE its precision decision — import
+    the policy layer (``surreal_tpu.ops.precision``) because it threads
+    the policy, or carry a ``# precision:`` comment naming why the module
+    is policy-transparent (dp wrappers, drivers whose dtypes live inside
+    ``learner.learn``). A silent module is how a new driver ships f32
+    staging under a bf16 policy without anyone noticing."""
+    bad = []
+    for entry in _DONATION_SCOPED_SOURCES:
+        root = _PKG_ROOT / entry
+        files = [root] if root.suffix == ".py" else sorted(root.rglob("*.py"))
+        for path in files:
+            src = path.read_text()
+            if "jax.jit(" not in src:
+                continue
+            if not any(m in src for m in _PRECISION_MARKERS):
+                bad.append(str(path.relative_to(_REPO_ROOT)))
+    assert not bad, (
+        "learner/trainer step modules with jitted hot programs but no "
+        "stated precision decision (import surreal_tpu.ops.precision or "
+        "add a '# precision:' comment naming why the module is "
+        "policy-transparent):\n" + "\n".join(bad)
+    )
+    # the learners themselves must thread the policy, not just mention it
+    for mod in ("learners/ppo.py", "learners/ddpg.py", "learners/impala.py"):
+        src = (_PKG_ROOT / mod).read_text()
+        assert "ops.precision import" in src or "ops import precision" in src, (
+            f"{mod} no longer imports the precision layer; the policy must "
+            "thread through every learner (ops/precision.py)"
+        )
+
+
+def test_pallas_kernels_declare_interpret_fallback():
+    """Pallas-kernel lint (ISSUE 7 satellite): every ``pl.pallas_call``
+    in the op library must declare an interpret-mode fallback — an
+    ``interpret`` kwarg in the call — so each kernel runs (and is
+    validated) on every backend, not just TPU. A kernel without the
+    fallback is dead code on the CPU test image and an untested landmine
+    on the chip."""
+    bad = []
+    has_kernels = False
+    for path in sorted((_PKG_ROOT / "ops").rglob("*.py")):
+        src = path.read_text()
+        for line, call in _call_spans(src, "pl.pallas_call"):
+            has_kernels = True
+            if "interpret" not in call:
+                bad.append(f"{path.relative_to(_REPO_ROOT)}:{line}")
+    assert has_kernels, "no pallas_call found under ops/ — update this lint"
+    assert not bad, (
+        "pl.pallas_call without an interpret-mode fallback (pass "
+        "interpret=... so off-TPU backends run the same program):\n"
+        + "\n".join(bad)
+    )
+
+
 _DATA_PLANE_STEADY_STATE = (
     # the steady-state serve/step loop modules: one pickle of an ndarray
     # payload per env step is exactly the cost the zero-copy transport

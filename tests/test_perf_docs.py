@@ -8,11 +8,57 @@ newest artifact on disk, and ``python perf_report.py --sync-readme``
 (benchmark-free, off-chip) is the one-command fix.
 """
 
+import io
+import json
 import os
 import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_perf_gate_passes_on_committed_artifacts():
+    """perf_gate in the loop (ISSUE 7 satellite): the committed
+    BENCH_r*.json trail must pass the regression gate — including the
+    intra-artifact precision-policy gate (bf16 wall-clock no worse than
+    the platform's incumbent arm, headline bytes-accessed >= 25% lower
+    under bf16 than f32) — as a tier-1 test, not just a CI afterthought."""
+    sys.path.insert(0, REPO)
+    try:
+        from perf_gate import gate
+    finally:
+        sys.path.pop(0)
+    out = io.StringIO()
+    rc = gate(REPO, threshold=0.10, out=out)
+    assert rc == 0, f"perf_gate failed on committed artifacts:\n{out.getvalue()}"
+
+
+def test_bench_r06_records_precision_bytes_commitment():
+    """The acceptance numbers live in the committed artifact, not only in
+    a transcript: BENCH_r06.json's headline-geometry cost rows must show
+    the >= 25% bytes-accessed reduction under the bf16 policy, with the
+    platform recorded honestly."""
+    path = os.path.join(REPO, "BENCH_r06.json")
+    if not os.path.exists(path):
+        return  # artifact trail not present (fresh clone subsets)
+    with open(path) as f:
+        parsed = json.load(f)["parsed"]
+    assert parsed["platform"], "platform must be recorded honestly"
+    costs = {
+        r["precision"]: r
+        for r in parsed["precision_sweep"]["headline_costs"]
+    }
+    f32, bf16 = costs["f32"], costs["bf16"]
+    assert (f32["num_envs"], f32["horizon"]) == (4096, 256), (
+        "headline cost rows must be at the headline geometry"
+    )
+    reduction = 1.0 - (
+        bf16["bytes_accessed_per_iter"] / f32["bytes_accessed_per_iter"]
+    )
+    assert reduction >= 0.25, (
+        f"bf16 policy bytes-accessed reduction {reduction:.1%} is below "
+        "the 25% commitment"
+    )
 
 
 def test_readme_cites_newest_bench_artifact():

@@ -1,0 +1,532 @@
+"""Precision-policy layer tests (ISSUE 7): policy resolution, dynamic
+loss scaling semantics, bf16-vs-f32 learner equivalence within the
+documented tolerances, the new Pallas kernels' interpret-mode validation
+against their XLA references, and the checkpoint policy-mismatch guard.
+
+Documented tolerances (the numbers the assertions pin):
+
+- bf16 vs f32 fused iterations: metrics agree to rtol 5e-2 / atol 5e-3,
+  params after one iteration to atol 5e-3 — bf16 rounds each activation
+  to 8 mantissa bits, so per-step drift is bounded by the activation
+  rounding amplified through one Adam step (step size <= lr).
+- 'mixed' vs 'bf16' agree much tighter (atol 1e-5): both compute in
+  bf16; bf16 only moves the f32->bf16 cast from per-minibatch-read to
+  staging (the same rounding point) and adds exact power-of-two loss
+  scaling.
+- Pallas recurrence kernels vs their XLA scans: <= 8 f32 ulps at unit
+  scale (atol 5e-6). The residual is XLA's FMA contraction inside the
+  compiled scan — the committed GAE kernel shows the identical delta on
+  this image; on-chip the round-3 measurement recorded exact equality.
+  The data-movement kernels (replay gather/scatter, discounted returns)
+  are bit-exact and asserted as such.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from surreal_tpu.envs import make_env
+from surreal_tpu.launch.rollout import device_rollout, init_device_carry
+from surreal_tpu.learners import build_learner
+from surreal_tpu.ops import precision as prec
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import BASE_ENV_CONFIG, base_config
+
+LEARN_KEYS = (
+    "obs", "next_obs", "action", "reward", "done", "terminated",
+    "behavior_logp", "behavior",
+)
+
+
+def _env(num_envs=8, name="jax:pendulum"):
+    return make_env(Config(name=name, num_envs=num_envs).extend(BASE_ENV_CONFIG))
+
+
+_FUSED_CACHE: dict = {}
+
+
+def _fused_iter(algo_name: str, policy: str, horizon=16, num_envs=8, **algo_kw):
+    """One rollout + learn under ``policy``; returns (state, metrics).
+    Memoized per exact config — several tests compare against the same
+    baseline arm, and each uncached call pays an XLA compile (the tier-1
+    wall-clock budget is the constraint)."""
+    cache_key = (algo_name, policy, horizon, num_envs, tuple(sorted(algo_kw.items())))
+    if cache_key in _FUSED_CACHE:
+        return _FUSED_CACHE[cache_key]
+    env = _env(num_envs)
+    cfg = Config(
+        algo=Config(name=algo_name, precision=policy, horizon=horizon, **algo_kw)
+    )
+    learner = build_learner(cfg, env.specs)
+    key = jax.random.key(0)
+    state = learner.init(jax.random.key(1))
+    carry = init_device_carry(env, jax.random.key(2), num_envs)
+
+    @jax.jit
+    def it(state, carry, key):
+        carry, batch = device_rollout(env, learner, state, carry, key, horizon)
+        lb = {k: batch[k] for k in LEARN_KEYS}
+        return learner.learn(state, lb, key)
+
+    state, metrics = it(state, carry, key)
+    out = (state, jax.device_get(metrics))
+    _FUSED_CACHE[cache_key] = out
+    return out
+
+
+# -- policy resolution -------------------------------------------------------
+
+
+def test_policy_resolution_defaults_and_overrides():
+    # the default is the pre-ISSUE-7 behavior, bit-for-bit: bf16 compute,
+    # f32 staging, NO loss-scale state in the optimizer pytree
+    p = prec.resolve_policy(Config(algo=Config(name="ppo")))
+    assert (p.name, p.compute_dtype, p.data_dtype, p.loss_scaling) == (
+        "mixed", "bfloat16", "float32", False,
+    )
+    p = prec.resolve_policy(Config(algo=Config(name="ppo", precision="f32")))
+    assert (p.compute_dtype, p.data_dtype, p.loss_scaling) == (
+        "float32", "float32", False,
+    )
+    p = prec.resolve_policy(Config(algo=Config(name="ppo", precision="bf16")))
+    assert (p.compute_dtype, p.data_dtype, p.loss_scaling, p.fp8) == (
+        "bfloat16", "bfloat16", True, False,
+    )
+    p = prec.resolve_policy(
+        Config(algo=Config(name="ppo", precision="bf16_fp8"))
+    )
+    assert p.fp8 and p.loss_scaling
+    # explicit model dtype overrides win (the pre-ISSUE-7 spelling)
+    p = prec.resolve_policy(
+        Config(
+            algo=Config(name="ppo", precision="bf16"),
+            model=Config(compute_dtype="float32"),
+        )
+    )
+    assert p.compute_dtype == "float32"
+    # loss scaling force-on for a policy whose auto is off
+    p = prec.resolve_policy(
+        Config(
+            algo=Config(name="ppo", precision="mixed"),
+            optimizer=Config(loss_scaling=Config(enabled=True)),
+        )
+    )
+    assert p.loss_scaling
+    with pytest.raises(ValueError, match="precision"):
+        prec.resolve_policy(Config(algo=Config(name="ppo", precision="fp4")))
+
+
+def test_model_config_materializes_auto_dtypes():
+    p = prec.resolve_policy(Config(algo=Config(name="ppo", precision="bf16")))
+    cfg = p.model_config(Config(dtype="auto", compute_dtype="auto"))
+    assert cfg["dtype"] == "float32" and cfg["compute_dtype"] == "bfloat16"
+    assert cfg["fp8"] is False
+
+
+# -- dynamic loss scaling ----------------------------------------------------
+
+
+def _ls_policy(**kw):
+    defaults = dict(
+        name="bf16", param_dtype="float32", compute_dtype="bfloat16",
+        data_dtype="bfloat16", fp8=False, loss_scaling=True,
+    )
+    return prec.PrecisionPolicy(**{**defaults, **kw})
+
+
+def _grads_like(params, value):
+    return jax.tree.map(lambda p: jnp.full_like(p, value), params)
+
+
+def test_loss_scaling_exact_on_healthy_steps():
+    """Power-of-two scaling must be a numeric no-op on finite gradients:
+    the wrapped chain's params match the unwrapped chain's bit-for-bit."""
+    from surreal_tpu.learners.base import make_optimizer_chain
+
+    params = {"w": jnp.linspace(-1.0, 1.0, 32)}
+    pol = _ls_policy()
+    tx_ls = make_optimizer_chain(1e-3, 0.5, pol)
+    tx_plain = make_optimizer_chain(1e-3, 0.5, pol._replace(loss_scaling=False))
+    s_ls, s_plain = tx_ls.init(params), tx_plain.init(params)
+    p_ls, p_plain = params, params
+    for i in range(5):
+        g = _grads_like(params, 0.01 * (i + 1))
+        scaled = jax.tree.map(lambda x: x * prec.current_loss_scale(s_ls), g)
+        u, s_ls = tx_ls.update(scaled, s_ls, p_ls)
+        p_ls = optax.apply_updates(p_ls, u)
+        u, s_plain = tx_plain.update(g, s_plain, p_plain)
+        p_plain = optax.apply_updates(p_plain, u)
+    np.testing.assert_array_equal(
+        np.asarray(p_ls["w"]), np.asarray(p_plain["w"])
+    )
+
+
+def test_loss_scaling_overflow_skips_step_and_backs_off():
+    from surreal_tpu.learners.base import make_optimizer_chain
+
+    params = {"w": jnp.ones(8)}
+    tx = make_optimizer_chain(1e-3, 0.5, _ls_policy())
+    state = tx.init(params)
+    ls0 = prec.current_loss_scale(state)
+    # a healthy step first, so Adam moments are nonzero
+    u, state = tx.update(_grads_like(params, 1.0 * ls0), state, params)
+    inner_before = state.inner
+    # overflow: inf gradients -> zero update, inner state UNTOUCHED,
+    # scale halved, good-step streak reset, overflow counter up
+    u, state = tx.update(_grads_like(params, np.inf), state, params)
+    assert all(float(jnp.abs(x).max()) == 0.0 for x in jax.tree.leaves(u))
+    for a, b in zip(jax.tree.leaves(inner_before), jax.tree.leaves(state.inner)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(state.scale) == float(ls0) * 0.5
+    assert int(state.good_steps) == 0
+    assert int(state.overflows) == 1
+    # NaN trips the same fence
+    u, state = tx.update(_grads_like(params, np.nan), state, params)
+    assert float(state.scale) == float(ls0) * 0.25
+    assert int(state.overflows) == 2
+
+
+def test_loss_scaling_growth_and_floor():
+    from surreal_tpu.learners.base import make_optimizer_chain
+
+    pol = _ls_policy(ls_init=4.0, ls_growth_interval=3, ls_min=1.0, ls_max=64.0)
+    params = {"w": jnp.ones(4)}
+    tx = make_optimizer_chain(1e-3, 0.5, pol)
+    state = tx.init(params)
+    for _ in range(3):
+        _, state = tx.update(_grads_like(params, 1.0), state, params)
+    assert float(state.scale) == 8.0  # grew after the interval
+    assert int(state.good_steps) == 0
+    # repeated overflows floor at ls_min, never zero
+    for _ in range(10):
+        _, state = tx.update(_grads_like(params, np.inf), state, params)
+    assert float(state.scale) == 1.0
+
+
+def test_loss_scale_metrics_and_helpers():
+    from surreal_tpu.learners.base import make_optimizer_chain
+
+    params = {"w": jnp.ones(4)}
+    pol = _ls_policy()
+    tx = make_optimizer_chain(1e-3, 0.5, pol)
+    state = tx.init(params)
+    m = prec.loss_scale_metrics(state)
+    assert float(m["precision/loss_scale"]) == pol.ls_init
+    assert float(m["precision/overflows"]) == 0.0
+    # chains without the wrapper report scale 1.0 and no metrics
+    plain = make_optimizer_chain(1e-3, 0.5, pol._replace(loss_scaling=False))
+    ps = plain.init(params)
+    assert float(prec.current_loss_scale(ps)) == 1.0
+    assert prec.loss_scale_metrics(ps) == {}
+
+
+def test_nan_guard_trips_on_true_nan_under_loss_scaling():
+    """A poisoned batch under the bf16 policy: the loss-scale wrapper
+    skips the step (params stay finite and UNCHANGED), while the
+    in-graph health guard still reports the nonfinite gradient — the
+    divergence layer's trip wire is not masked by the skip."""
+    env = _env()
+    learner = build_learner(
+        Config(algo=Config(name="ppo", precision="bf16", horizon=8)), env.specs
+    )
+    state = learner.init(jax.random.key(0))
+    carry = init_device_carry(env, jax.random.key(1), 8)
+    _, batch = jax.jit(
+        lambda s, c, k: device_rollout(env, learner, s, c, k, 8)
+    )(state, carry, jax.random.key(2))
+    lb = {k: batch[k] for k in LEARN_KEYS}
+    lb["reward"] = lb["reward"].at[0, 0].set(jnp.inf)  # poison
+    new_state, metrics = jax.jit(learner.learn)(state, lb, jax.random.key(3))
+    assert float(metrics["health/nonfinite"]) == 1.0
+    # every minibatch step saw the poisoned advantages: all skipped
+    for a, b in zip(
+        jax.tree.leaves(state.params), jax.tree.leaves(new_state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(prec.current_loss_scale(new_state.opt_state)) < float(
+        prec.current_loss_scale(state.opt_state)
+    )
+
+
+# -- bf16-vs-f32 learner equivalence -----------------------------------------
+
+
+def _tree_close(a, b, atol):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32), atol=atol
+        )
+
+
+@pytest.mark.parametrize("algo", ["ppo", "impala"])
+def test_bf16_vs_f32_fused_iteration(algo):
+    # impala pins vtrace_impl so the cache key collides with the
+    # vtrace-equivalence test's xla arm (one compile, not two)
+    extra = {"vtrace_impl": "xla"} if algo == "impala" else {}
+    s32, m32 = _fused_iter(algo, "f32", **extra)
+    s16, m16 = _fused_iter(algo, "bf16", **extra)
+    for k in ("loss/pg", "loss/value", "policy/entropy"):
+        np.testing.assert_allclose(m16[k], m32[k], rtol=5e-2, atol=5e-3)
+    _tree_close(s16.params, s32.params, atol=5e-3)
+    # and 'bf16' vs 'mixed' is tight: same compute dtype, staging cast at
+    # the same rounding point, exact loss scaling
+    sm, mm = _fused_iter(algo, "mixed", **extra)
+    for k in ("loss/pg", "loss/value"):
+        np.testing.assert_allclose(m16[k], mm[k], rtol=1e-5, atol=1e-6)
+    _tree_close(s16.params, sm.params, atol=1e-5)
+
+
+def test_bf16_vs_f32_ddpg_updates():
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    def run(policy):
+        cfg = Config(
+            learner_config=Config(
+                algo=Config(
+                    name="ddpg", precision=policy, horizon=8,
+                    updates_per_iter=4,
+                ),
+                replay=Config(start_sample_size=32, capacity=512, batch_size=16),
+            ),
+            env_config=Config(name="jax:pendulum", num_envs=8),
+            session_config=Config(
+                folder="/tmp/test_precision_ddpg",
+                metrics=Config(every_n_iters=10_000),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+            ),
+        ).extend(base_config())
+        tr = OffPolicyTrainer(cfg)
+        key = jax.random.key(0)
+        state = tr.learner.init(jax.random.key(1))
+        carry, rs = tr.init_loop_state(jax.random.key(2))
+        first = True
+        for _ in range(3):
+            state, rs, carry, metrics = tr._train_iter(
+                state, rs, carry, key, jnp.float32(0), jnp.asarray(False),
+                jnp.asarray(first),
+            )
+            first = False
+        return state, jax.device_get(metrics)
+
+    s32, m32 = run("f32")
+    s16, m16 = run("bf16")
+    np.testing.assert_allclose(
+        m16["loss/critic"], m32["loss/critic"], rtol=5e-2, atol=5e-3
+    )
+    # 3 iterations x 4 updates = 12 Adam steps at lr 1e-3: worst-case
+    # per-param drift is bounded by ~12 x lr when the bf16 rounding flips
+    # a gradient sign near zero — hence the wider budget than the
+    # single-step on-policy case above
+    _tree_close(s16.actor_params, s32.actor_params, atol=2e-2)
+    _tree_close(s16.critic_params, s32.critic_params, atol=2e-2)
+
+
+def test_fp8_path_runs_and_stays_finite():
+    state, metrics = _fused_iter("ppo", "bf16_fp8", horizon=8)
+    assert float(metrics["health/nonfinite"]) == 0.0
+    assert all(
+        bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(state.params)
+    )
+
+
+# -- Pallas kernel validation (interpret mode) -------------------------------
+
+
+def _vtrace_inputs(T=16, B=37, seed=0):
+    rng = np.random.default_rng(seed)
+    f = lambda *s: jnp.asarray(rng.standard_normal(s).astype(np.float32))
+    done = jnp.asarray(rng.random((T, B)) < 0.1)
+    return dict(
+        behaviour_logp=f(T, B) * 0.1 - 1.0,
+        target_logp=f(T, B) * 0.1 - 1.0,
+        rewards=f(T, B),
+        values=f(T, B),
+        values_next=f(T, B),
+        done=done,
+        terminated=done & jnp.asarray(rng.random((T, B)) < 0.5),
+    )
+
+
+def test_pallas_vtrace_nextobs_matches_xla():
+    from surreal_tpu.ops.pallas_vtrace import vtrace_nextobs_pallas
+    from surreal_tpu.ops.vtrace import vtrace_nextobs, vtrace_nextobs_assoc
+
+    kw = _vtrace_inputs()
+    ref = vtrace_nextobs(**kw, gamma=0.99)
+    pal = vtrace_nextobs_pallas(**kw, gamma=0.99, interpret=True)
+    # <= 8 f32 ulps at unit scale: the residual is XLA's FMA contraction
+    # inside the compiled scan (the committed GAE kernel shows the same
+    # delta on this image; on-chip the round-3 measurement was exact)
+    np.testing.assert_allclose(ref.vs, pal.vs, atol=5e-6, rtol=0)
+    np.testing.assert_allclose(
+        ref.pg_advantages, pal.pg_advantages, atol=5e-6, rtol=0
+    )
+    asc = vtrace_nextobs_assoc(**kw, gamma=0.99)
+    np.testing.assert_allclose(ref.vs, asc.vs, atol=1e-5, rtol=0)
+    np.testing.assert_allclose(
+        ref.pg_advantages, asc.pg_advantages, atol=1e-5, rtol=0
+    )
+
+
+def test_pallas_vtrace_simple_contract_matches_xla():
+    from surreal_tpu.ops.pallas_vtrace import vtrace_pallas
+    from surreal_tpu.ops.vtrace import vtrace
+
+    T, B = 12, 40
+    rng = np.random.default_rng(1)
+    f = lambda *s: jnp.asarray(rng.standard_normal(s).astype(np.float32))
+    done = jnp.asarray(rng.random((T, B)) < 0.1)
+    disc = 0.99 * (1.0 - done.astype(jnp.float32))
+    args = (f(T, B) * 0.1, f(T, B) * 0.1, f(T, B), disc, f(T + 1, B))
+    ref = vtrace(*args)
+    pal = vtrace_pallas(*args, interpret=True)
+    np.testing.assert_allclose(ref.vs, pal.vs, atol=5e-6, rtol=0)
+    np.testing.assert_allclose(
+        ref.pg_advantages, pal.pg_advantages, atol=5e-6, rtol=0
+    )
+
+
+def test_pallas_discounted_returns_bit_exact():
+    from surreal_tpu.ops.pallas_returns import discounted_returns_pallas
+    from surreal_tpu.ops.returns import discounted_returns
+
+    T, B = 20, 50
+    rng = np.random.default_rng(2)
+    r = jnp.asarray(rng.standard_normal((T, B)).astype(np.float32))
+    d = 0.97 * (1.0 - (jnp.asarray(rng.random((T, B))) < 0.1).astype(jnp.float32))
+    boot = jnp.asarray(rng.standard_normal(B).astype(np.float32))
+    ref = discounted_returns(r, d, boot)
+    pal = discounted_returns_pallas(r, d, boot, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(pal))
+
+
+def test_pallas_replay_gather_scatter_bit_exact():
+    from surreal_tpu.ops.pallas_replay import (
+        gather_rows_pallas,
+        scatter_rows_pallas,
+    )
+
+    rng = np.random.default_rng(3)
+    storage = jnp.asarray(rng.standard_normal((64, 3, 5)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, 64, 17), jnp.int32)
+    got = gather_rows_pallas(storage, idx, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(storage[idx]))
+    # 1-D leaves (rewards, priorities) route through the same kernels
+    prios = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+    idx2 = jnp.asarray(rng.permutation(64)[:10], jnp.int32)
+    upd = jnp.asarray(rng.standard_normal(10).astype(np.float32))
+    out = scatter_rows_pallas(prios, idx2, upd, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(prios.at[idx2].set(upd))
+    )
+    # bf16 storage (the bf16 policy's replay buffer) copies verbatim
+    st16 = storage.astype(jnp.bfloat16)
+    got16 = gather_rows_pallas(st16, idx, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(got16, np.float32), np.asarray(st16[idx], np.float32)
+    )
+
+
+def test_uniform_replay_pallas_gather_record_equivalent():
+    from surreal_tpu.replay.uniform import UniformReplay
+
+    example = {
+        "obs": jnp.zeros((6,), jnp.float32),
+        "reward": jnp.zeros((), jnp.float32),
+    }
+    rng = np.random.default_rng(4)
+    batch = {
+        "obs": jnp.asarray(rng.standard_normal((40, 6)).astype(np.float32)),
+        "reward": jnp.asarray(rng.standard_normal(40).astype(np.float32)),
+    }
+    keys = jax.random.split(jax.random.key(0), 4)
+    out = {}
+    for impl in ("xla", "pallas"):
+        rep = UniformReplay(
+            Config(capacity=64, batch_size=8, start_sample_size=8,
+                   gather_impl=impl)
+        )
+        state = rep.insert(rep.init(example), batch)
+        _, batches, idx = rep.sample_many(state, keys)
+        out[impl] = (jax.device_get(batches), jax.device_get(idx))
+    np.testing.assert_array_equal(out["xla"][1], out["pallas"][1])
+    for k in example:
+        np.testing.assert_array_equal(out["xla"][0][k], out["pallas"][0][k])
+
+
+def test_impala_vtrace_impl_equivalence():
+    outs = {
+        impl: _fused_iter("impala", "mixed", vtrace_impl=impl)
+        for impl in ("xla", "assoc", "pallas")
+    }  # the xla arm is the memoized baseline from the bf16-vs-f32 test
+    ref = outs["xla"][1]
+    for impl in ("assoc", "pallas"):
+        for k in ("loss/pg", "loss/value"):
+            np.testing.assert_allclose(
+                outs[impl][1][k], ref[k], rtol=1e-4, atol=1e-5
+            )
+        _tree_close(outs[impl][0].params, outs["xla"][0].params, atol=1e-4)
+
+
+# -- checkpoint policy guard -------------------------------------------------
+
+
+def test_precision_metadata_guard_units(tmp_path):
+    from surreal_tpu.session.checkpoint import (
+        CheckpointManager,
+        PrecisionMismatchError,
+    )
+
+    mgr = CheckpointManager(str(tmp_path))
+    bf16 = prec.resolve_policy(
+        Config(algo=Config(name="ppo", precision="bf16"))
+    ).meta()
+    f32 = prec.resolve_policy(
+        Config(algo=Config(name="ppo", precision="f32"))
+    ).meta()
+    # legacy folder (no sidecar): guard passes
+    mgr.check_precision(bf16)
+    mgr.save_run_metadata(bf16)
+    assert mgr.run_metadata() == bf16
+    mgr.check_precision(bf16)  # matching: fine
+    with pytest.raises(PrecisionMismatchError) as err:
+        mgr.check_precision(f32)
+    msg = str(err.value)
+    assert "bf16" in msg and "f32" in msg and "algo.precision" in msg
+    mgr.close()
+
+
+def test_precision_mismatch_fails_restore_loudly(tmp_path):
+    """End-to-end: a session checkpointed under bf16 refuses an f32
+    relaunch with the named error (not an orbax structure traceback)."""
+    from surreal_tpu.launch.trainer import Trainer
+    from surreal_tpu.session.checkpoint import PrecisionMismatchError
+
+    def cfg(policy):
+        return Config(
+            learner_config=Config(
+                algo=Config(name="ppo", precision=policy, horizon=8,
+                            epochs=1, num_minibatches=2),
+            ),
+            # 8 envs: conftest simulates 8 host devices and the trainer's
+            # default dp mesh spans them all
+            env_config=Config(name="jax:pendulum", num_envs=8),
+            session_config=Config(
+                folder=str(tmp_path),
+                metrics=Config(every_n_iters=1, tensorboard=False),
+                checkpoint=Config(every_n_iters=1),
+                eval=Config(every_n_iters=0),
+                telemetry=Config(enabled=True),
+            ),
+        ).extend(base_config())
+
+    Trainer(cfg("bf16")).run(max_env_steps=32)  # one iteration + ckpt
+    with pytest.raises(PrecisionMismatchError, match="algo.precision"):
+        Trainer(cfg("f32")).run(max_env_steps=32)
+    # a matching relaunch resumes cleanly
+    Trainer(cfg("bf16")).run(max_env_steps=64)
