@@ -272,6 +272,13 @@ def main() -> int:
         from perf_wallclock import host_path_main
 
         return host_path_main(sys.argv[1:])
+    if "--experience-plane" in sys.argv:
+        # sharded experience plane campaign (ISSUE 8): remote shm/tcp/
+        # pickle arms vs the in-process replay reference — writes
+        # BENCH_experience.json (perf_gate's experience gate consumes it)
+        from perf_wallclock import experience_plane_main
+
+        return experience_plane_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

@@ -177,13 +177,74 @@ def gate_precision(art_dir: str, newest_file: str, threshold: float,
     return rc
 
 
+def gate_experience(art_dir: str, out=sys.stdout) -> int:
+    """Experience-plane gate (ISSUE 8 satellite): when a committed
+    ``BENCH_experience.json`` exists (``bench.py --experience-plane``),
+    enforce the plane's two commitments on the image it was measured on:
+
+    - the shm arm's wire bytes per ingested transition stay within 2x of
+      the PR-3 slab record (``shm_wire_record_bps`` in the artifact) —
+      the control-frames-only contract;
+    - the learner's sample-wait EWMA stays under 10% of the iteration
+      time (floored at 2 ms for sub-20ms iterations) — the
+      "learner never waits on experience ingest" contract.
+
+    rc 0 with a note when the artifact is absent or from a failed round
+    (a missing campaign is not a regression).
+    """
+    path = os.path.join(art_dir, "BENCH_experience.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_experience.json — experience plane not "
+              "measured (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_experience.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    shm = data.get("shm") or {}
+    record = float(data.get("shm_wire_record_bps", 5.8))
+    wire = shm.get("wire_bytes_per_step")
+    if wire is not None:
+        line = (
+            f"perf_gate: experience shm wire {float(wire):.1f} B/step vs "
+            f"PR-3 slab record {record:.1f} (commitment <= {2 * record:.1f})"
+        )
+        if float(wire) > 2.0 * record:
+            print(line + " — ABOVE COMMITMENT", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    wait = shm.get("sample_wait_ms")
+    iter_ms = shm.get("iter_ms")
+    if wait is not None and iter_ms:
+        budget = max(0.10 * float(iter_ms), 2.0)
+        line = (
+            f"perf_gate: experience learner sample-wait "
+            f"{float(wait):.2f} ms of a {float(iter_ms):.1f} ms iteration "
+            f"(commitment <= {budget:.2f} ms)"
+        )
+        if float(wait) > budget:
+            print(line + " — LEARNER WAITS ON INGEST", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    return rc
+
+
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
+    # the experience-plane gate is independent of the BENCH_r* trail:
+    # run it first and fold its verdict into every return path
+    xp_rc = gate_experience(art_dir, out=out)
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]
     if not rows:
         print("perf_gate: no BENCH_*.json artifacts found — nothing to "
               "gate (rc 0)", file=out)
-        return 0
+        return xp_rc
     newest = rows[-1]
     if newest.get("failed"):
         print(
@@ -191,7 +252,7 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
             "round (no parsed row) — a missing measurement is a campaign "
             "problem, not a regression (rc 0)", file=out,
         )
-        return 0
+        return xp_rc
     # intra-artifact precision gate rides every verdict below: the
     # cross-round compare and the per-policy commitments are independent
     prec_rc = gate_precision(art_dir, newest["file"], threshold, out=out)
@@ -206,7 +267,7 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
             "earlier committed artifact with the same fingerprint — "
             "nothing to compare (rc 0)", file=out,
         )
-        return prec_rc
+        return max(prec_rc, xp_rc)
     ratio = newest["value"] / baseline["value"] if baseline["value"] else 1.0
     verdict = (
         f"perf_gate: {newest['file']} {newest['value']:,.1f} vs baseline "
@@ -218,7 +279,7 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
         print(verdict + " — REGRESSION", file=out)
         return 1
     print(verdict + " — ok", file=out)
-    return prec_rc
+    return max(prec_rc, xp_rc)
 
 
 def main(argv=None) -> int:

@@ -299,6 +299,179 @@ def host_path_main(argv) -> int:
     return 0
 
 
+# -- sharded experience plane (--experience-plane) ----------------------------
+
+XP_SHM_WIRE_RECORD = 5.8  # PR-3 slab record (wire B/step, BENCH_host.json)
+XP_NUM_ENVS = 8
+XP_HORIZON = 32
+XP_UPDATES = 8
+XP_BATCH = 128
+XP_SHARDS = 2
+XP_WARM = 4
+XP_MEAS = 16
+
+
+def _xp_trainer(kind: str, transport: str, folder: str, seed: int = 0):
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    replay = Config(
+        kind="remote" if kind == "remote" else "uniform",
+        remote_kind="uniform",
+        capacity=16_384, start_sample_size=512, batch_size=XP_BATCH,
+    )
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(
+                name="ddpg", horizon=XP_HORIZON,
+                updates_per_iter=XP_UPDATES,
+                exploration=Config(warmup_steps=0),
+            ),
+            replay=replay,
+        ),
+        env_config=Config(name="gym:Pendulum-v1", num_envs=XP_NUM_ENVS),
+        session_config=Config(
+            folder=folder,
+            seed=seed,
+            total_env_steps=10**12,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(
+                experience_plane=Config(
+                    num_shards=XP_SHARDS, shard_mode="thread",
+                    transport=transport,
+                ),
+            ),
+        ),
+    ).extend(base_config())
+    return OffPolicyTrainer(cfg)
+
+
+def _xp_measure(kind: str, transport: str) -> dict:
+    """One off-policy run (remote plane arm, or the in-process reference)
+    at the local-shards geometry; warm iterations discarded. Records the
+    settled experience gauges and the fixed-seed reward trajectory so the
+    remote-vs-in-process curves ride the artifact."""
+    import shutil
+    import tempfile
+
+    folder = tempfile.mkdtemp(prefix="bench_xp_")
+    trainer = _xp_trainer(kind, transport, folder)
+    marks: list[tuple[float, float]] = []
+    returns: list = []
+    last: dict = {}
+
+    def on_m(it, m):
+        marks.append((time.perf_counter(), m["time/env_steps"]))
+        r = m.get("episode/return")
+        if r is not None and r == r:
+            returns.append(round(float(r), 2))
+        last.update(m)
+        return len(marks) >= XP_WARM + XP_MEAS
+
+    try:
+        trainer.run(on_metrics=on_m)
+    finally:
+        shutil.rmtree(folder, ignore_errors=True)
+    t0, s0 = marks[XP_WARM - 1]
+    t1, s1 = marks[-1]
+    n = len(marks) - XP_WARM
+    row = {
+        "arm": kind if kind != "remote" else f"remote-{transport}",
+        "env_steps_per_s": round((s1 - s0) / (t1 - t0), 1),
+        "iter_ms": round((t1 - t0) / n * 1e3, 2),
+        "episode_returns": returns,
+        "final_return": returns[-1] if returns else None,
+    }
+    if kind == "remote":
+        row.update({
+            "wire_bytes_per_step": last.get("experience/wire_bytes_per_step"),
+            "sample_wait_ms": last.get("experience/sample_wait_ms"),
+            "shards_live": last.get("experience/shards_live"),
+            "rows_ingested": last.get("experience/rows"),
+            "dropped_rows": last.get("experience/dropped_rows"),
+            "respawns": last.get("experience/respawns"),
+        })
+    return row
+
+
+def experience_plane_main(argv) -> int:
+    """--experience-plane driver (ISSUE 8 satellite): measure the remote
+    plane per transport arm (shm / tcp / pickle, 2 local thread shards)
+    against the in-process replay reference at the same fixed-seed
+    geometry; write the BENCH_experience.json artifact perf_gate's
+    experience gate and PERF.md's generated section consume. Platform is
+    recorded honestly; the shm arm's wire-bytes and the learner
+    sample-wait are the gated commitments."""
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_experience.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    try:
+        import gymnasium  # noqa: F401
+    except Exception as e:
+        result = {"error": f"gymnasium unavailable: {e}", "parsed": None}
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+        return 0
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            inproc = _xp_measure("inprocess", "auto")
+            arms = {
+                t: _xp_measure("remote", t) for t in ("shm", "tcp", "pickle")
+            }
+            shm = arms["shm"]
+            result = {
+                "metric": "experience_plane_env_steps_per_sec_ddpg_pendulum",
+                "value": shm["env_steps_per_s"],
+                "unit": "env_steps/s",
+                "geometry": (
+                    f"{XP_NUM_ENVS} gym:Pendulum-v1 envs x {XP_HORIZON} "
+                    f"horizon x {XP_UPDATES} updates/iter (batch "
+                    f"{XP_BATCH}) over {XP_SHARDS} local thread shards"
+                ),
+                "shards": XP_SHARDS,
+                "shard_mode": "thread",
+                "shm_wire_record_bps": XP_SHM_WIRE_RECORD,
+                "inprocess": inproc,
+                "shm": shm,
+                "tcp": arms["tcp"],
+                "pickle": arms["pickle"],
+                # the device actually measured (bench.py discipline)
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"experience-plane attempt {attempt + 1}/{RETRY_ATTEMPTS}"
+                    f" failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -306,6 +479,8 @@ def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if "--host-path" in argv:
         sys.exit(host_path_main(argv))
+    if "--experience-plane" in argv:
+        sys.exit(experience_plane_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

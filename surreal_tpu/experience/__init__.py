@@ -1,0 +1,37 @@
+"""Cross-host experience plane (ISSUE 8 tentpole): the
+ExperienceSender -> ReplayShardServer -> ShardedSampler path the
+reference ran as separate processes behind a caraml proxy, rebuilt on the
+PR-3 transport discipline so many actor fleets on other hosts can feed
+one learner group.
+
+Modules:
+
+- ``wire``    — the experience wire codec: transport-negotiated framing
+                (shm slabs same-host, a length-framed TCP codec
+                cross-host, pickle as the per-peer fallback), hello
+                handshake carrying the run trace id.
+- ``shard``   — ``run_shard_server``: one replay shard process/thread
+                owning a host-memory NumPy ring (uniform + prioritized,
+                mirroring ``replay/base.py`` semantics) plus the SEED
+                FIFO chunk relay.
+- ``sender``  — ``ExperienceSender``: actor-side hash-routing of env
+                slots to shards with backpressure and bounded
+                retry/backoff.
+- ``sampler`` — ``ShardedSampler``: learner-side fan-in, prefetched
+                through ``learners/prefetch.py::Prefetcher`` so the
+                learner never waits on experience ingest.
+- ``plane``   — ``ExperiencePlane``: lifecycle (spawn, supervise,
+                respawn with exponential backoff, close/unlink) + the
+                ``experience/*`` gauges.
+"""
+
+from surreal_tpu.experience.plane import ExperiencePlane
+from surreal_tpu.experience.sender import ExperienceSender, shard_of_slot
+from surreal_tpu.experience.sampler import ShardedSampler
+
+__all__ = [
+    "ExperiencePlane",
+    "ExperienceSender",
+    "ShardedSampler",
+    "shard_of_slot",
+]

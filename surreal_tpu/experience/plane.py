@@ -1,0 +1,329 @@
+"""ExperiencePlane: lifecycle + supervision for the sharded experience
+plane — spawn the shard servers (threads for in-process tests, OS
+processes for real deployments; both run ``shard.run_shard_server``),
+build the sender/sampler pair, respawn dead shards under the SEED
+supervisor's exponential-backoff schedule, and aggregate the
+``experience/*`` gauges + per-hop telemetry the diag "Experience plane"
+section renders.
+
+Shard addresses are fixed at construction (the parent allocates the
+ports), so a respawned shard binds the SAME endpoint and every client's
+DEALER reconnects + re-negotiates in place — no rendezvous service, the
+RollArt-style disaggregated tier (arXiv:2512.22560) with the transport
+kept this repo's own (PR-3 hello/slab discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Mapping
+
+import numpy as np
+
+from surreal_tpu.experience import wire
+from surreal_tpu.experience.sampler import ShardedSampler
+from surreal_tpu.experience.sender import ExperienceSender
+from surreal_tpu.experience.shard import run_shard_server
+from surreal_tpu.utils import faults
+
+
+def _alloc_address() -> str:
+    """Pick a free loopback port (bind-then-close; the same small TOCTOU
+    window the --local-procs coordinator accepts — a lost race surfaces
+    as a shard bind failure and a supervised respawn)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"tcp://127.0.0.1:{s.getsockname()[1]}"
+
+
+class ExperiencePlane:
+    # a respawn that survives this long clears its shard's failure streak
+    _HEALTHY_S = 10.0
+
+    def __init__(
+        self,
+        *,
+        kind: str = "uniform",
+        example: Mapping[str, Any] | None = None,
+        capacity: int = 100_000,
+        batch_size: int = 256,
+        start_sample_size: int = 1_000,
+        updates_per_iter: int = 1,
+        num_slots: int = 1,
+        max_insert_rows: int = 1024,
+        priority_alpha: float = 0.6,
+        priority_beta0: float = 0.4,
+        priority_eps: float = 1e-6,
+        cfg: Mapping[str, Any] | None = None,
+        base_key=None,
+        trace_id: str | None = None,
+        prefetch: bool = True,
+        device_put: bool = True,
+    ):
+        cfg = dict(cfg or {})
+        self.kind = kind
+        self.num_shards = max(1, int(cfg.get("num_shards", 2)))
+        self.shard_mode = cfg.get("shard_mode", "thread")
+        if self.shard_mode not in ("thread", "process"):
+            raise ValueError(
+                f"experience_plane.shard_mode {self.shard_mode!r} not in "
+                "thread|process"
+            )
+        self.transport = cfg.get("transport", "auto")
+        self.trace_id = trace_id
+        self.start_sample_size = int(start_sample_size)
+        self._backoff_base = float(cfg.get("respawn_backoff_s", 0.5))
+        self._backoff_cap = float(cfg.get("respawn_backoff_cap_s", 30.0))
+        S = self.num_shards
+        if kind != "fifo":
+            for field, value in (("capacity", capacity),
+                                 ("batch_size", batch_size)):
+                if int(value) % S:
+                    raise ValueError(
+                        f"replay.{field}={value} must be divisible by "
+                        f"experience_plane.num_shards={S} (the "
+                        "scale_replay_config rule, applied across hosts)"
+                    )
+        self._shard_cfg = {
+            "kind": kind if kind != "remote" else "uniform",
+            "capacity": int(capacity) // S if kind != "fifo" else 0,
+            "priority_alpha": float(priority_alpha),
+            "priority_beta0": float(priority_beta0),
+            "priority_eps": float(priority_eps),
+            "watermark_timeout_s": float(cfg.get("watermark_timeout_s", 5.0)),
+            "fifo_depth": int(cfg.get("fifo_depth", 64)),
+        }
+        self.addresses = [_alloc_address() for _ in range(S)]
+        self._stop = threading.Event()
+        self._fault_plan_sent: set[int] = set()
+        self.respawns = 0
+        self.respawn_backoff_s = 0.0
+        now = time.monotonic()
+        self._failures = [0] * S
+        self._next_spawn_at = [0.0] * S
+        self._spawned_at = [now] * S
+        self._supervise_lock = threading.Lock()
+        self.shards = [self._spawn_shard(i) for i in range(S)]
+
+        spec = (
+            wire.PlaneSpec.from_example(example)
+            if example is not None else None
+        )
+        self.spec = spec
+        self.sender = ExperienceSender(
+            self.addresses, spec,
+            num_slots=int(num_slots),
+            slot_rows=int(max_insert_rows),
+            transport=self.transport,
+            insert_slots=int(cfg.get("insert_slots", 4)),
+            trace=trace_id,
+            ack_timeout_s=float(cfg.get("ack_timeout_s", 5.0)),
+            respawn_backoff_s=self._backoff_base,
+            respawn_backoff_cap_s=self._backoff_cap,
+            stop_event=self._stop,
+        )
+        self.sampler = ShardedSampler(
+            self.addresses, spec,
+            batch_size=int(batch_size),
+            kind=kind,
+            base_key=base_key,
+            updates_per_iter=int(updates_per_iter),
+            transport=self.transport,
+            trace=trace_id,
+            prefetch=prefetch and kind != "fifo",
+            sample_timeout_s=float(cfg.get("sample_timeout_s", 10.0)),
+            respawn_backoff_s=self._backoff_base,
+            respawn_backoff_cap_s=self._backoff_cap,
+            device_put=device_put,
+            stop_event=self._stop,
+        )
+        self._stats_socks: list = [None] * S
+        self._stats_cache: list[dict] = [{} for _ in range(S)]
+        self._stats_seq = 0
+        self._rows_prev: tuple[float, float] | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def _spawn_shard(self, i: int):
+        kwargs: dict[str, Any] = dict(trace_id=self.trace_id)
+        if self.shard_mode == "process":
+            import multiprocessing as mp
+
+            import jax
+
+            # chaos harness: forward the plan on the FIRST spawn per index
+            # only — a respawned shard restarts call counters at zero and
+            # would re-fire one-shot kills forever (the SEED rule)
+            plan = faults.get().plan
+            if plan and i not in self._fault_plan_sent:
+                kwargs["fault_plan"] = plan
+                self._fault_plan_sent.add(i)
+            kwargs.update(
+                # a shard is a host-memory service: it must never grab
+                # this host's accelerator, and its random stream must
+                # match the trainer's partitionable setting bit-for-bit
+                force_cpu=True,
+                threefry_partitionable=bool(
+                    jax.config.jax_threefry_partitionable
+                ),
+                untrack_slabs=True,  # the trainer-side plane owns unlinks
+            )
+            ctx = mp.get_context("spawn")
+            w = ctx.Process(
+                target=run_shard_server,
+                args=(dict(self._shard_cfg), self.addresses[i], i),
+                kwargs=kwargs,
+                daemon=True,
+            )
+        else:
+            w = threading.Thread(
+                target=run_shard_server,
+                args=(dict(self._shard_cfg), self.addresses[i], i),
+                kwargs=dict(kwargs, stop_event=self._stop),
+                daemon=True,
+                name=f"xp-shard-{i}",
+            )
+        w.start()
+        return w
+
+    def supervise(self) -> None:
+        """Respawn dead shards in place (same address — clients
+        re-negotiate on their own) under the exponential-backoff schedule;
+        a respawn that stays healthy clears its streak."""
+        with self._supervise_lock:
+            now = time.monotonic()
+            for i, w in enumerate(self.shards):
+                if w.is_alive():
+                    if (
+                        self._failures[i]
+                        and now - self._spawned_at[i] > self._HEALTHY_S
+                    ):
+                        self._failures[i] = 0
+                    continue
+                if now < self._next_spawn_at[i]:
+                    continue  # backing off a crash-looping shard
+                self.shards[i] = self._spawn_shard(i)
+                self.respawns += 1
+                self._failures[i] += 1
+                self._spawned_at[i] = now
+                backoff = min(
+                    self._backoff_cap,
+                    self._backoff_base * 2.0 ** (self._failures[i] - 1),
+                )
+                self._next_spawn_at[i] = now + backoff
+                self.respawn_backoff_s = backoff
+
+    # -- gauges / telemetry --------------------------------------------------
+    def _poll_stats(self, timeout_ms: int = 200) -> None:
+        """Refresh the per-shard stats cache over dedicated main-thread
+        DEALER channels (the sample socket lives on the prefetch thread).
+        Dead shards keep their last snapshot."""
+        import zmq
+
+        ctx = zmq.Context.instance()
+        self._stats_seq += 1
+        pending = []
+        for i in range(self.num_shards):
+            if self._stats_socks[i] is None:
+                sock = ctx.socket(zmq.DEALER)
+                sock.setsockopt(zmq.SNDTIMEO, 1000)
+                sock.connect(self.addresses[i])
+                self._stats_socks[i] = sock
+            try:
+                self._stats_socks[i].send(
+                    wire.encode_stats(self._stats_seq), zmq.NOBLOCK
+                )
+                pending.append(i)
+            except zmq.ZMQError:
+                continue
+        deadline = time.monotonic() + timeout_ms / 1e3
+        while pending and time.monotonic() < deadline:
+            for i in list(pending):
+                if not self._stats_socks[i].poll(20):
+                    continue
+                try:
+                    kind, obj = wire.decode_payload(
+                        self._stats_socks[i].recv(zmq.NOBLOCK)
+                    )
+                except zmq.Again:
+                    continue
+                if kind == "stats_ok":
+                    # stale seqs still carry a valid snapshot; keep newest
+                    self._stats_cache[i] = obj["stats"]
+                    if int(obj["seq"]) >= self._stats_seq:
+                        pending.remove(i)
+
+    def gauges(self, poll: bool = True) -> dict[str, float]:
+        """The ``experience/*`` metrics-row gauges (documented in
+        ``session/costs.py::GAUGE_REGISTRY``). ``poll=True`` refreshes the
+        shard stats over the wire first — call at the metrics cadence, not
+        every iteration."""
+        if poll:
+            self._poll_stats()
+        live = sum(1 for w in self.shards if w.is_alive())
+        stats = self._stats_cache
+        rows = sum(float(s.get("ingested_rows", 0)) for s in stats)
+        fills = [float(s.get("fill", 0.0)) for s in stats if s]
+        wire_bytes = (
+            sum(float(s.get("wire_bytes_in", 0)) for s in stats)
+            + sum(float(s.get("wire_bytes_out", 0)) for s in stats)
+        )
+        out = {
+            "experience/shards_live": float(live),
+            "experience/respawns": float(self.respawns),
+            "experience/rows": rows,
+            "experience/fill": (
+                float(np.mean(fills)) if fills else 0.0
+            ),
+            "experience/ingest_rows_per_s": sum(
+                float(s.get("ingest_rows_per_s", 0.0)) for s in stats
+            ),
+            "experience/wire_bytes_per_step": wire_bytes / max(rows, 1.0),
+            "experience/sample_queue_depth": sum(
+                float(s.get("sample_queue_depth", 0)) for s in stats
+            ),
+            "experience/sample_wait_ms": float(self.sampler.sample_wait_ms),
+            "experience/dropped_rows": float(self.sender.dropped_rows),
+        }
+        return out
+
+    def telemetry_event(self) -> dict:
+        """The ``experience_plane`` telemetry event body: per-shard
+        snapshots (the per-shard replay/* gauges diag renders) + the
+        sender/sampler hop view."""
+        return {
+            "kind": self.kind,
+            "num_shards": self.num_shards,
+            "shard_mode": self.shard_mode,
+            "transports": [l.transport for l in self.sender.links],
+            "shards": {
+                str(i): {
+                    k: v for k, v in s.items()
+                    if k not in ("wire_bytes_in", "wire_bytes_out")
+                }
+                for i, s in enumerate(self._stats_cache) if s
+            },
+            "sender": self.sender.gauges(),
+            "sampler": self.sampler.gauges(),
+            **{
+                k.split("/", 1)[1]: v for k, v in self.gauges(poll=False).items()
+                if k in (
+                    "experience/wire_bytes_per_step",
+                    "experience/sample_wait_ms",
+                )
+            },
+        }
+
+    def close(self) -> None:
+        self._stop.set()
+        self.sampler.close()
+        self.sender.close()
+        for w in self.shards:
+            if hasattr(w, "terminate"):
+                w.terminate()
+            w.join(timeout=5)
+        for sock in self._stats_socks:
+            if sock is not None:
+                sock.close(0)

@@ -1,0 +1,443 @@
+"""ExperienceSender: the actor-side half of the experience plane (parity:
+the reference's ExperienceSender hash-routing experience to replay shards
+behind the caraml proxy, SURVEY.md §2.1).
+
+Routing is a pure function of the env slot (``shard_of_slot`` — crc32,
+stable across processes like ``param_service.address_for``), so every
+transition an env produces lands on the same shard and the learner-side
+fan-in reassembles a stationary mixture.
+
+Backpressure + retry (the PR-5 discipline): each shard link bounds its
+unacked INSERT window (shm: the slab's slot count — a slot is reused only
+after its ack; tcp/pickle: ``insert_slots`` frames). A full window blocks
+the SENDER (never the learner — sends happen on the collector/staging
+thread), acks are awaited with a bounded timeout, unacked frames are
+RESENT with exponential backoff (the shard dedups by seq), and an
+exhausted budget marks the shard dead: its rows are dropped and counted
+while the rest of the fleet keeps ingesting, with re-negotiation attempts
+backed off ``base * 2^k`` capped exactly like the SEED worker respawn
+schedule.
+
+Faults: site ``experience.send`` fires per outgoing frame
+(``corrupt_wire_frame`` scrambles the payload on the wire — the shard
+counts + drops it and the retry path redelivers; ``drop_frame`` /
+``delay_frame`` as in the host data plane).
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from surreal_tpu.experience import wire
+from surreal_tpu.utils import faults
+
+
+def shard_of_slot(slot: int, num_shards: int) -> int:
+    """Deterministic env-slot -> shard route (crc32: stable across
+    processes, unlike the builtin salted hash). Hashes the slot's 8-byte
+    little-endian encoding — crc32 of short ASCII digit strings is
+    pathologically unbalanced mod small shard counts (slots 0-3 all land
+    odd), while the fixed-width form covers every shard within the first
+    ``num_shards`` slots for the 2/4-shard geometries."""
+    return zlib.crc32(int(slot).to_bytes(8, "little")) % num_shards
+
+
+class _ShardLink:
+    """One DEALER connection to one shard server."""
+
+    def __init__(self, address: str, shard_id: int, identity: str):
+        import zmq
+
+        self.address = address
+        self.shard_id = shard_id
+        self.sock = zmq.Context.instance().socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.IDENTITY, identity.encode())
+        self.sock.setsockopt(zmq.SNDTIMEO, 10_000)
+        self.sock.connect(address)
+        self.transport = "pickle"
+        self.negotiated = False
+        self.spec: wire.PlaneSpec | None = None
+        self.slab = None
+        self.views: list[dict] = []
+        self.free_slots: list[int] = []
+        self.seq = 0
+        # seq -> [slab slot or None, resendable frame bytes, n rows,
+        #         monotonic send stamp (refreshed on resend)]
+        self.inflight: dict[int, list] = {}
+        self.sent_rows = 0
+        self.dead = False
+        self.failures = 0
+        self.next_attempt = 0.0
+        self.stale_resends = 0    # consecutive no-ack resend rounds
+
+    def close(self) -> None:
+        # CLIENT-owned slab cleanup (wire.create_slab's rule): unlink the
+        # shard-created segment we attached to
+        self.views = []
+        wire.unlink_slab(self.slab)
+        self.slab = None
+        self.sock.close(100)
+
+
+class ExperienceSender:
+    def __init__(
+        self,
+        addresses: Sequence[str],
+        spec: wire.PlaneSpec | None,
+        num_slots: int,
+        slot_rows: int,
+        transport: str = "auto",
+        insert_slots: int = 4,
+        trace: str | None = None,
+        retries: int = 3,
+        backoff_s: float = 0.25,
+        ack_timeout_s: float = 5.0,
+        hello_timeout_s: float = 60.0,
+        respawn_backoff_s: float = 0.5,
+        respawn_backoff_cap_s: float = 30.0,
+        name: str = "sender",
+        stop_event=None,
+    ):
+        self.addresses = list(addresses)
+        self.spec = spec  # None for the FIFO arm (derived from chunk 1)
+        self.mode = transport
+        self.slot_rows = int(slot_rows)
+        self.insert_slots = max(1, int(insert_slots))
+        self.trace = trace
+        self.retries = max(0, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.hello_timeout_s = float(hello_timeout_s)
+        self._respawn_base = float(respawn_backoff_s)
+        self._respawn_cap = float(respawn_backoff_cap_s)
+        # set at plane shutdown: every bounded wait below bails so the
+        # thread running sends (collector/staging/relay) can be JOINED
+        # before the plane closes the sockets it is using — zmq sockets
+        # are not thread-safe, concurrent use+close is undefined
+        self._stop = stop_event
+        S = len(self.addresses)
+        self.links = [
+            _ShardLink(a, s, f"xp-{name}-{s}")
+            for s, a in enumerate(self.addresses)
+        ]
+        # env slot -> shard route, precomputed for the row masks
+        self.route = np.array(
+            [shard_of_slot(i, S) for i in range(int(num_slots))], np.int64
+        )
+        self.dropped_rows = 0
+        self.resends = 0
+        self.wire_bytes = 0
+        self._rr = 0  # FIFO-arm round-robin cursor
+        if self.spec is not None:
+            for link in self.links:
+                self._negotiate(link, self.hello_timeout_s)
+
+    # -- negotiation ---------------------------------------------------------
+    def _negotiate(self, link: _ShardLink, timeout_s: float) -> bool:
+        """Run the hello handshake on one link; marks the link dead on
+        timeout (revived later under the backoff schedule). The hello
+        carries a per-attempt token the reply must echo — a stale grant
+        from an earlier timed-out attempt must be dropped, not attached
+        (the shard unlinks superseded grants on its side)."""
+        import secrets
+
+        import zmq
+
+        token = secrets.token_hex(4)
+        want = wire.resolve_transport(self.mode, link.address)
+        if want == "pickle":
+            payload = wire.encode_pickle_msg({
+                "kind": "hello", "role": "sender",
+                "spec": self.spec.to_json() if self.spec else None,
+                "slot_rows": self.slot_rows, "slots": self.insert_slots,
+                "transport": "pickle", "trace": self.trace, "token": token,
+                "seq_base": link.seq,
+            })
+        else:
+            payload = wire.encode_hello(
+                "sender", self.spec, self.slot_rows, self.insert_slots,
+                want, trace=self.trace, token=token, seq_base=link.seq,
+            )
+        try:
+            self._send_raw(link, payload)
+        except zmq.ZMQError:
+            return self._mark_dead(link)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._stop is not None and self._stop.is_set():
+                return self._mark_dead(link)
+            if not link.sock.poll(100):
+                continue
+            kind, obj = wire.decode_payload(link.sock.recv())
+            if kind == "msg":
+                kind = obj.get("kind", "?")
+            if (
+                kind in ("hello_ok", "hello_no")
+                and obj.get("token") == token
+            ):
+                break
+            # stray acks / stale grants from earlier attempts: drop and
+            # keep waiting (the shard unlinked any superseded slab)
+        else:
+            return self._mark_dead(link)
+        if kind == "hello_no":
+            return self._mark_dead(link)
+        granted = obj.get("transport", "tcp")
+        old_slab = link.slab
+        link.slab, link.views = None, []
+        if granted == "shm":
+            try:
+                layout = wire.PlaneSlab.from_json(obj["slab"])
+                link.slab = wire.attach_slab(obj["name"])
+                link.views = layout.views(link.slab.buf)
+                link.free_slots = list(range(layout.slots))
+            except (OSError, ValueError, KeyError):
+                granted = "tcp"  # degraded, never dead: raw codec always works
+        link.transport = granted
+        if old_slab is not None and (link.slab is None
+                                     or old_slab.name != link.slab.name):
+            # renegotiation replaced the segment: unlink the orphan NOW
+            # (client-owned cleanup — a SIGKILLed shard can't do it)
+            wire.unlink_slab(old_slab)
+        # a respawned shard restarts empty: re-base the watermark counter
+        # on what it actually holds, so samplers' deferral stays consistent
+        link.sent_rows = int(obj.get("ingested_rows", 0))
+        for _slot, _f, n, _t in link.inflight.values():
+            # frames unacked across a re-hello are never resent (a spec
+            # change invalidated them): counted, never silent — the same
+            # contract _mark_dead keeps, and the precondition the shard's
+            # dedup compaction relies on
+            self.dropped_rows += n
+        link.inflight.clear()
+        link.negotiated = True
+        link.dead = False
+        link.failures = 0
+        link.stale_resends = 0
+        return True
+
+    def _mark_dead(self, link: _ShardLink) -> bool:
+        link.dead = True
+        link.failures += 1
+        backoff = min(
+            self._respawn_cap, self._respawn_base * 2.0 ** (link.failures - 1)
+        )
+        link.next_attempt = time.monotonic() + backoff
+        for slot, _f, n, _t in link.inflight.values():
+            # undelivered rows die with the link (counted, never silent)
+            self.dropped_rows += n
+            if slot is not None and slot not in link.free_slots:
+                link.free_slots.append(slot)
+        link.inflight.clear()
+        return False
+
+    def _revive(self, link: _ShardLink) -> bool:
+        if link.negotiated and not link.dead:
+            return True
+        if link.dead and time.monotonic() < link.next_attempt:
+            return False
+        # first contact gets the generous budget (a spawned shard is still
+        # importing); revival probes are quick — the backoff schedule
+        # bounds how often they fire
+        return self._negotiate(
+            link, self.hello_timeout_s if not link.dead else 2.0
+        )
+
+    # -- wire ----------------------------------------------------------------
+    def _send_raw(self, link: _ShardLink, payload: bytes) -> None:
+        f = faults.fire("experience.send")
+        if f is not None:
+            if f["kind"] == "drop_frame":
+                return  # swallowed on the wire; the ack retry redelivers
+            if f["kind"] == "delay_frame":
+                faults.sleep_ms(f)
+            elif f["kind"] == "corrupt_wire_frame":
+                # scramble the frame on the wire (keep length): the shard
+                # must count + drop it, and the retry must redeliver
+                corrupted = bytearray(payload)
+                for i in range(0, len(corrupted), 7):
+                    corrupted[i] ^= 0xA5
+                payload = bytes(corrupted)
+        self.wire_bytes += len(payload)
+        link.sock.send(payload)
+
+    def _pump(self, link: _ShardLink, timeout_ms: int = 0) -> None:
+        """Drain acks on one link (non-blocking by default)."""
+        import zmq
+
+        while link.sock.poll(timeout_ms):
+            timeout_ms = 0
+            try:
+                kind, obj = wire.decode_payload(link.sock.recv(zmq.NOBLOCK))
+            except zmq.Again:
+                return
+            if kind == "msg":
+                kind = obj.get("kind", "?")
+            if kind == "insert_ok":
+                entry = link.inflight.pop(int(obj["seq"]), None)
+                link.stale_resends = 0
+                if entry is not None and entry[0] is not None:
+                    link.free_slots.append(entry[0])
+
+    def _retry_stale(self, link: _ShardLink) -> None:
+        """Liveness for half-open links: an unacked frame older than the
+        ack budget is resent even when the window is NOT full (without
+        this, a dropped/corrupted frame would only redeliver once the
+        window filled — and a watermarked sample would stall until the
+        shard's deferral timeout). Staleness is PER FRAME (its own send
+        stamp, refreshed on resend); ``retries`` consecutive no-ack
+        resend rounds declare the shard dead."""
+        if not link.inflight:
+            return
+        now = time.monotonic()
+        stale = [
+            entry for entry in link.inflight.values()
+            if now - entry[3] >= self.ack_timeout_s
+        ]
+        if not stale:
+            return
+        if link.stale_resends >= self.retries:
+            self._mark_dead(link)
+            return
+        link.stale_resends += 1
+        self.resends += len(stale)
+        for entry in stale:
+            self._send_raw(link, entry[1])
+            entry[3] = now
+
+    def _await_window(self, link: _ShardLink, need_slot: bool) -> bool:
+        """Block (collector thread, never the learner) until the link has
+        send credit: an ack frees a slab slot / an inflight-window entry.
+        Bounded: ``retries`` resend rounds with exponential backoff, then
+        the shard is declared dead and its rows drop."""
+        window = len(link.views) or self.insert_slots
+        for attempt in range(self.retries + 1):
+            deadline = time.monotonic() + self.ack_timeout_s
+            while time.monotonic() < deadline:
+                if self._stop is not None and self._stop.is_set():
+                    self._mark_dead(link)  # counts the inflight rows
+                    return False
+                self._pump(link, timeout_ms=50)
+                if len(link.inflight) < window and (
+                    not need_slot or link.free_slots
+                ):
+                    return True
+            # resend every unacked frame (the shard dedups by seq)
+            if attempt < self.retries:
+                self.resends += len(link.inflight)
+                now = time.monotonic()
+                for _seq, entry in sorted(link.inflight.items()):
+                    self._send_raw(link, entry[1])
+                    entry[3] = now
+                if self._stop is not None:
+                    if self._stop.wait(self.backoff_s * 2.0 ** attempt):
+                        self._mark_dead(link)
+                        return False
+                else:
+                    time.sleep(self.backoff_s * 2.0 ** attempt)
+        self._mark_dead(link)
+        return False
+
+    def _send_insert(self, link: _ShardLink, spec: wire.PlaneSpec,
+                     rows: Mapping[str, np.ndarray], n: int) -> bool:
+        if not self._revive(link):
+            self.dropped_rows += n
+            return False
+        self._pump(link)
+        self._retry_stale(link)
+        if link.dead:
+            self.dropped_rows += n
+            return False
+        need_slot = link.transport == "shm"
+        if not self._await_window(link, need_slot):
+            self.dropped_rows += n
+            return False
+        link.seq += 1
+        t_send = time.time() if wire.local_address(link.address) else 0.0
+        if link.transport == "shm":
+            slot = link.free_slots.pop(0)
+            v = link.views[slot]
+            for name in spec.names():
+                v[name][:n] = rows[name][:n]
+            frame = wire.encode_insert(link.seq, n, slot, t_send=t_send)
+            link.inflight[link.seq] = [slot, frame, n, time.monotonic()]
+        elif link.transport == "pickle":
+            frame = wire.encode_pickle_msg({
+                "kind": "insert", "seq": link.seq, "n": n,
+                "rows": {k: np.ascontiguousarray(v[:n]) for k, v in rows.items()},
+                "t_send": t_send,
+            })
+            link.inflight[link.seq] = [None, frame, n, time.monotonic()]
+        else:
+            body = spec.pack(rows, n)
+            frame = wire.encode_insert(
+                link.seq, n, 0, t_send=t_send, body=body
+            )
+            link.inflight[link.seq] = [None, frame, n, time.monotonic()]
+        self._send_raw(link, frame)
+        link.sent_rows += n
+        return True
+
+    # -- public API ----------------------------------------------------------
+    def send_rows(self, rows: Mapping[str, np.ndarray],
+                  slots: np.ndarray) -> list[int]:
+        """Hash-route a flat transition batch to its shards; returns the
+        per-shard sent-row watermarks AFTER this batch (the sampler's
+        deferral contract). ``slots[i]`` is row i's env slot."""
+        flat = wire.flatten_fields(rows)
+        targets = self.route[np.asarray(slots, np.int64)]
+        for s, link in enumerate(self.links):
+            mask = targets == s
+            n = int(mask.sum())
+            if n == 0:
+                continue
+            sub = {k: np.ascontiguousarray(v[mask]) for k, v in flat.items()}
+            self._send_insert(link, self.spec, sub, n)
+        return self.watermarks()
+
+    def send_chunk(self, chunk: Mapping[str, np.ndarray]) -> bool:
+        """FIFO arm (SEED): ship one whole trajectory chunk to the next
+        shard round-robin. The chunk's spec is derived from its first
+        instance (rows = the time axis)."""
+        flat = {
+            k: np.ascontiguousarray(v) for k, v in
+            wire.flatten_fields(chunk).items()
+        }
+        n = int(next(iter(flat.values())).shape[0])
+        spec = wire.PlaneSpec(
+            [(k, v.shape[1:], v.dtype) for k, v in flat.items()]
+        )
+        if self.spec is None or not self.spec.matches(spec):
+            self.spec = spec
+            self.slot_rows = max(self.slot_rows, n)
+            for link in self.links:
+                link.negotiated = False  # re-hello with the (new) spec
+        link = self.links[self._rr % len(self.links)]
+        self._rr += 1
+        ok = self._send_insert(link, self.spec, flat, n)
+        if not ok and len(self.links) > 1:
+            # dead shard: route this chunk to the next one instead of
+            # dropping a whole trajectory (rows already counted dropped)
+            link = self.links[self._rr % len(self.links)]
+            self._rr += 1
+            ok = self._send_insert(link, self.spec, flat, n)
+        return ok
+
+    def watermarks(self) -> list[int]:
+        return [link.sent_rows for link in self.links]
+
+    def gauges(self) -> dict[str, float]:
+        return {
+            "sent_rows": float(sum(l.sent_rows for l in self.links)),
+            "dropped_rows": float(self.dropped_rows),
+            "resends": float(self.resends),
+            "wire_bytes_out": float(self.wire_bytes),
+            "dead_links": float(sum(1 for l in self.links if l.dead)),
+        }
+
+    def close(self) -> None:
+        for link in self.links:
+            link.close()

@@ -233,6 +233,14 @@ class SessionHooks:
         )
         self.tracer.event("data_plane", **info)
 
+    def experience_event(self, **info) -> None:
+        """Record the experience plane's settled shape (shard transports,
+        per-shard fill/ingest, wire bytes/step, sample-wait) as one
+        telemetry ``experience_plane`` event per metrics row —
+        ``surreal_tpu diag``'s "Experience plane" section renders the
+        last one plus the per-hop sender->shard->learner percentiles."""
+        self.tracer.event("experience_plane", **info)
+
     def record_program_costs(
         self, name: str, jitted, *args,
         phase: str | None = None, calls_per_phase: int = 1, **kwargs,

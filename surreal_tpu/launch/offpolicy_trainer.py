@@ -103,7 +103,27 @@ class OffPolicyTrainer:
         self.num_envs = config.env_config.num_envs
         self.device_mode = is_jax_env(self.env)
         self.seed = config.session_config.seed
-        self.prioritized = self.learner.config.replay.kind == "prioritized"
+        # remote experience plane (surreal_tpu/experience/): replay lives
+        # in shard-server processes fed by an ExperienceSender and drained
+        # by a prefetched ShardedSampler — `replay.kind='remote'` with
+        # `replay.remote_kind` selecting the shard discipline. Host path
+        # only: the device path's replay IS device memory (replay/sharded
+        # dp shards); a host-memory shard tier behind a fused device loop
+        # would reintroduce the per-iteration host sync the fusion removed.
+        replay_kind = self.learner.config.replay.kind
+        self.remote = replay_kind == "remote"
+        if self.remote and self.device_mode:
+            raise ValueError(
+                "replay.kind='remote' (the sharded experience plane) runs "
+                "the host off-policy path; device (jax:*) envs keep "
+                "in-process device-resident replay — use a host env, or "
+                "replay.kind='uniform'|'prioritized'"
+            )
+        self.prioritized = replay_kind == "prioritized" or (
+            self.remote
+            and self.learner.config.replay.get("remote_kind", "uniform")
+            == "prioritized"
+        )
         self.mesh = None
         if self.device_mode:
             from surreal_tpu.parallel.mesh import make_mesh
@@ -136,7 +156,12 @@ class OffPolicyTrainer:
                     self._device_train_iter, donate_argnums=(0, 1, 2)
                 )
         else:
-            self.replay = build_replay(self._replay_build_cfg)
+            # remote plane: no in-process replay object — the buffer lives
+            # in the shard servers (built inside _run_host_remote, where
+            # the session's trace id exists)
+            self.replay = (
+                None if self.remote else build_replay(self._replay_build_cfg)
+            )
             # acting reuses the same state every env step: never donate
             self._act = jax.jit(
                 self.learner.act, static_argnames="mode", donate_argnums=()
@@ -145,26 +170,28 @@ class OffPolicyTrainer:
             # from the latest published state — the very buffers a
             # donating learn would invalidate mid-rollout
             self._learn = jax.jit(self.learner.learn, donate_argnums=())
-            # replay state is loop-carried on the train thread only:
-            # donate it through insert/sample/priority-refresh so the
-            # host path updates the buffer in place too
-            self._insert = jax.jit(self.replay.insert, donate_argnums=(0,))
-            self._sample = jax.jit(self.replay.sample, donate_argnums=(0,))
             # NOT donated: at n_step=1 `full` IS the rollout traj, which
             # update_obs_stats still reads after the fold
             self._nstep = jax.jit(
                 lambda traj: nstep_transitions(traj, algo.gamma, algo.n_step),
                 donate_argnums=(),
             )
-            if self.prioritized:
-                self._update_prio = jax.jit(
-                    self.replay.update_priorities, donate_argnums=(0,)
-                )
+            if not self.remote:
+                # replay state is loop-carried on the train thread only:
+                # donate it through insert/sample/priority-refresh so the
+                # host path updates the buffer in place too
+                self._insert = jax.jit(self.replay.insert, donate_argnums=(0,))
+                self._sample = jax.jit(self.replay.sample, donate_argnums=(0,))
+                if self.prioritized:
+                    self._update_prio = jax.jit(
+                        self.replay.update_priorities, donate_argnums=(0,)
+                    )
         # uniform-replay fast path (see run_updates in _device_train_iter):
         # one batched index draw + gather for the whole update loop.
         # hasattr gates replay kinds without a batched sampler (fifo).
         self._batched_sampling = (
             not self.prioritized
+            and not self.remote
             and bool(algo.get("batched_uniform_sampling", True))
             and hasattr(self.replay, "sample_many")
         )
@@ -488,7 +515,10 @@ class OffPolicyTrainer:
             if self.tune_decision.mode != "off":
                 hooks.tune_event(**self.tune_decision.telemetry())
             if not self.device_mode:
-                return self._run_host(
+                runner = (
+                    self._run_host_remote if self.remote else self._run_host
+                )
+                return runner(
                     total, on_metrics, hooks, state, iteration, env_steps
                 )
             if self.mesh is not None and self.mesh.size > 1:
@@ -586,6 +616,75 @@ class OffPolicyTrainer:
         return b0 + (1.0 - b0) * frac
 
     # -- host path -----------------------------------------------------------
+    def _explore_rollout(self, hooks, roll, a_state, warmup, act_dim):
+        """One H-step exploration rollout, shared by the host paths
+        (in-process ``collect_chunk`` and the remote plane's
+        ``collect_and_send``): warmup/OU/training actions, terminal-obs
+        and truncation handling, episode-reset noise masking. Mutates
+        ``roll`` (key/obs/noise); returns (time-major numpy trajectory
+        dict, completed-episode returns) — the returns ride the staged
+        item so only the MAIN thread touches the metrics deque (extending
+        it from the staging thread would race host_metrics' iteration of
+        the deque, the same hazard trainer.py's overlap collector routes
+        through its queue)."""
+        explo = self.algo.exploration
+        steps: list[dict] = []
+        chunk_returns: list[float] = []
+        obs, noise = roll["obs"], roll["noise"]
+        with hooks.tracer.span("rollout"):
+            for _ in range(self.horizon):
+                roll["key"], akey, nkey = jax.random.split(roll["key"], 3)
+                if warmup:
+                    action = np.random.default_rng(
+                        int(jax.random.randint(akey, (), 0, 2**31 - 1))
+                    ).uniform(
+                        -1.0, 1.0, (self.num_envs, act_dim)
+                    ).astype(np.float32)
+                elif explo.noise == "ou":
+                    a_det, _ = self._act(
+                        a_state, jnp.asarray(obs), akey,
+                        mode="eval_deterministic",
+                    )
+                    # np.array (copy), NOT np.asarray: asarray of a jax
+                    # array is a read-only view, and the episode-reset
+                    # masking below writes into it
+                    noise = np.array(ou_noise_step(
+                        jnp.asarray(noise), nkey, explo.ou_theta,
+                        explo.sigma, explo.ou_dt,
+                    ))
+                    action = np.clip(np.asarray(a_det) + noise, -1.0, 1.0)
+                else:
+                    a, _ = self._act(
+                        a_state, jnp.asarray(obs), akey, mode="training"
+                    )
+                    action = np.asarray(a)
+                out = self.env.step(action)
+                term_obs = out.info.get("terminal_obs", out.obs)
+                done_b = out.done.reshape(
+                    out.done.shape + (1,) * (out.obs.ndim - 1)
+                )
+                truncated = np.asarray(out.info.get(
+                    "truncated", np.zeros(len(out.done), bool)
+                ))
+                steps.append({
+                    "obs": obs,
+                    "next_obs": np.where(done_b, term_obs, out.obs),
+                    "action": action,
+                    "reward": out.reward,
+                    "done": out.done,
+                    "terminated": out.done & ~truncated,
+                })
+                if out.done.any():
+                    noise[out.done] = 0.0
+                if "episode_returns" in out.info:
+                    chunk_returns.extend(
+                        np.asarray(out.info["episode_returns"]).tolist()
+                    )
+                obs = out.obs
+        roll["obs"], roll["noise"] = obs, noise
+        traj = {k: np.stack([s[k] for s in steps]) for k in steps[0]}
+        return traj, chunk_returns
+
     def _run_host(self, total, on_metrics, hooks, state, iteration, env_steps):
         """Host-env loop. With ``topology.overlap_rollouts`` (default on)
         the exploration rollout + its host->device staging run on a
@@ -651,64 +750,15 @@ class OffPolicyTrainer:
         steps_holder = [env_steps]
 
         def collect_chunk():
-            """One H-step exploration rollout, stacked time-major and
-            shipped to device as one transfer. Returns (device_traj,
-            completed-episode returns) — the returns ride the staged item
-            so only the MAIN thread touches recent_returns (extending it
-            from this thread would race host_metrics' iteration of the
-            deque, the same hazard trainer.py's overlap collector routes
-            through its queue)."""
-            steps = []
-            chunk_returns = []
-            obs, noise = roll["obs"], roll["noise"]
-            a_state = act_holder[0]  # one coherent policy per chunk
-            warmup = steps_holder[0] < explo.warmup_steps
-            with hooks.tracer.span("rollout"):
-                for _ in range(self.horizon):
-                    roll["key"], akey, nkey = jax.random.split(roll["key"], 3)
-                    if warmup:
-                        action = np.random.default_rng(
-                            int(jax.random.randint(akey, (), 0, 2**31 - 1))
-                        ).uniform(-1.0, 1.0, (self.num_envs, act_dim)).astype(np.float32)
-                    elif explo.noise == "ou":
-                        a_det, _ = self._act(a_state, jnp.asarray(obs), akey, mode="eval_deterministic")
-                        # np.array (copy), NOT np.asarray: asarray of a jax
-                        # array is a read-only view, and the episode-reset
-                        # masking below writes into it
-                        noise = np.array(
-                            ou_noise_step(jnp.asarray(noise), nkey, explo.ou_theta, explo.sigma, explo.ou_dt)
-                        )
-                        action = np.clip(np.asarray(a_det) + noise, -1.0, 1.0)
-                    else:
-                        a, _ = self._act(a_state, jnp.asarray(obs), akey, mode="training")
-                        action = np.asarray(a)
-                    out = self.env.step(action)
-                    term_obs = out.info.get("terminal_obs", out.obs)
-                    done_b = out.done.reshape(out.done.shape + (1,) * (out.obs.ndim - 1))
-                    truncated = np.asarray(out.info.get("truncated", np.zeros(len(out.done), bool)))
-                    steps.append(
-                        {
-                            "obs": obs,
-                            "next_obs": np.where(done_b, term_obs, out.obs),
-                            "action": action,
-                            "reward": out.reward,
-                            "done": out.done,
-                            "terminated": out.done & ~truncated,
-                        }
-                    )
-                    if out.done.any():
-                        noise[out.done] = 0.0
-                    if "episode_returns" in out.info:
-                        chunk_returns.extend(np.asarray(out.info["episode_returns"]).tolist())
-                    obs = out.obs
-            roll["obs"], roll["noise"] = obs, noise
+            """One H-step exploration rollout (``_explore_rollout``),
+            stacked time-major and shipped to device as one transfer.
+            Returns (device_traj, completed-episode returns)."""
+            traj, chunk_returns = self._explore_rollout(
+                hooks, roll, act_holder[0],  # one coherent policy per chunk
+                steps_holder[0] < explo.warmup_steps, act_dim,
+            )
             with hooks.tracer.span("h2d-transfer"):
-                return (
-                    jax.device_put(
-                        {k: np.stack([s[k] for s in steps]) for k in steps[0]}
-                    ),
-                    chunk_returns,
-                )
+                return jax.device_put(traj), chunk_returns
 
         overlap = bool(
             self.config.session_config.topology.get("overlap_rollouts", True)
@@ -818,3 +868,219 @@ class OffPolicyTrainer:
         finally:
             if prefetch is not None:
                 prefetch.close()
+
+    # -- remote experience plane (host path) ---------------------------------
+    def _run_host_remote(self, total, on_metrics, hooks, state, iteration,
+                         env_steps):
+        """Host loop over the sharded experience plane
+        (``replay.kind='remote'``, surreal_tpu/experience/): the collector
+        thread hash-routes every folded transition to the shard servers
+        through the ExperienceSender, and the learner consumes batches the
+        ShardedSampler prefetched from ALL shards during the PREVIOUS
+        iteration's SGD drain — the learner never waits on experience
+        ingest (the residue is the experience/sample_wait_ms gauge).
+
+        Pipeline discipline: iteration k requests its batches (watermarked
+        at chunk k's per-shard row counts) and trains on the batches
+        requested at iteration k-1 — one chunk of bounded sampling
+        staleness, the same bounded-lag class as ``overlap_rollouts``'s
+        acting staleness. Under ``overlap_rollouts=false`` the record is
+        exactly reproducible run-to-run (watermark deferral at the shard
+        — tests pin it)."""
+        from collections import deque
+
+        from surreal_tpu.experience import ExperiencePlane
+        from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW, host_metrics
+        from surreal_tpu.learners.prefetch import Prefetcher
+
+        steps_per_iter = self.horizon * self.num_envs
+        act_dim = int(self.env.specs.action.shape[0])
+        replay_cfg = self.learner.config.replay
+        ckpt_cfg = self.config.session_config.checkpoint
+        if ckpt_cfg.get("include_replay", False):
+            hooks.log.warning(
+                "checkpoint.include_replay is not supported with "
+                "replay.kind='remote' (the buffer lives in the shard "
+                "servers); resumes refill through warmup"
+            )
+        base_key = jax.random.key(self.seed + 1)
+        key = jax.random.fold_in(base_key, 0)  # update/learn key chain
+        explo = self.algo.exploration
+        n = self.algo.n_step
+        B = self.num_envs
+        obs_shape = self.env.specs.obs.shape
+        if n > 1:
+            host_tail = {
+                "obs": np.zeros((n - 1, B, *obs_shape), np.float32),
+                "next_obs": np.zeros((n - 1, B, *obs_shape), np.float32),
+                "action": np.zeros((n - 1, B, act_dim), np.float32),
+                "reward": np.zeros((n - 1, B), np.float32),
+                "done": np.ones((n - 1, B), bool),
+                "terminated": np.ones((n - 1, B), bool),
+            }
+        else:
+            host_tail = None
+
+        plane = ExperiencePlane(
+            kind="prioritized" if self.prioritized else "uniform",
+            example=jax.device_get(self._replay_example()),
+            capacity=int(replay_cfg.capacity),
+            batch_size=int(replay_cfg.batch_size),
+            start_sample_size=int(replay_cfg.start_sample_size),
+            updates_per_iter=int(self.algo.updates_per_iter),
+            num_slots=B,
+            # worst-case rows one chunk routes to ONE shard: every folded
+            # window (tail prepend keeps window count == horizon)
+            max_insert_rows=self.horizon * B,
+            priority_alpha=float(replay_cfg.priority_alpha),
+            priority_beta0=float(replay_cfg.priority_beta0),
+            priority_eps=float(replay_cfg.priority_eps),
+            cfg=self.config.session_config.topology.get(
+                "experience_plane", None
+            ),
+            base_key=jax.random.fold_in(base_key, 2),
+            trace_id=hooks.trace_id,
+        )
+        recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
+        roll = {
+            "key": jax.random.fold_in(base_key, 1),
+            "obs": self.env.reset(seed=self.config.env_config.seed),
+            "noise": np.zeros((B, act_dim), np.float32),
+            "tail": host_tail,
+            "first": True,
+        }
+        act_holder = [state]
+        steps_holder = [env_steps]
+        # row s*B+b of the flattened window fold belongs to env slot b
+        row_slots = np.arange(self.horizon * B, dtype=np.int64) % B
+
+        def collect_and_send():
+            """One exploration chunk: rollout (``_explore_rollout``) ->
+            n-step fold -> hash-route to the shards. Runs on the staging
+            thread under overlap, so ingest (including the fold's device
+            round trip) never blocks the learner. Returns (per-shard
+            watermarks AFTER this chunk, the chunk's obs stack,
+            completed-episode returns)."""
+            traj, chunk_returns = self._explore_rollout(
+                hooks, roll, act_holder[0],
+                steps_holder[0] < explo.warmup_steps, act_dim,
+            )
+            if roll["tail"] is not None:
+                full = {
+                    k: np.concatenate([roll["tail"][k], traj[k]], axis=0)
+                    for k in traj
+                }
+                roll["tail"] = {k: v[-(n - 1):] for k, v in full.items()}
+            else:
+                full = traj
+            trans = self._nstep(full)
+            if roll["tail"] is not None and roll["first"]:
+                # the run's first prepended tail is fabricated — same
+                # scrub as the in-process host path
+                trans = scrub_fake_prefix_windows(trans, n, B)
+            roll["first"] = False
+            with hooks.tracer.span("experience-send"):
+                wm = plane.sender.send_rows(
+                    jax.device_get(trans), row_slots
+                )
+            return wm, traj["obs"], chunk_returns
+
+        overlap = bool(
+            self.config.session_config.topology.get("overlap_rollouts", True)
+        )
+        prefetch = (
+            Prefetcher(collect_and_send, name="offpolicy-xp-stage")
+            if overlap else None
+        )
+        pending_jobs = 0
+        try:
+            while env_steps < total:
+                f = faults.fire("trainer.iteration")
+                if f is not None:
+                    state = faults.apply_trainer_fault(f, state)
+                    act_holder[0] = state
+                # consume the batches prefetched during the PREVIOUS
+                # iteration's learn drain (zero-wait in the steady state —
+                # the sample-wait span/gauge measures the residue). This
+                # runs BEFORE the next chunk is sent in strict mode, which
+                # is exactly what makes the record deterministic: the
+                # shard serves every watermarked sample at the precise
+                # ring state the watermark names.
+                staged = None
+                if pending_jobs:
+                    with hooks.tracer.span("sample-wait"):
+                        staged = plane.sampler.get_iteration()
+                    pending_jobs -= 1
+                if prefetch is not None:
+                    with hooks.tracer.span("chunk-wait"):
+                        wm, obs_chunk, ep_returns = prefetch.get()
+                else:
+                    wm, obs_chunk, ep_returns = collect_and_send()
+                recent_returns.extend(ep_returns)
+                state = self.learner.update_obs_stats(state, obs_chunk)
+                if sum(wm) >= int(replay_cfg.start_sample_size):
+                    plane.sampler.request_iteration(
+                        wm, self._beta(env_steps, total)
+                    )
+                    pending_jobs += 1
+                metrics = {}
+                if staged:
+                    infos, tds = [], []
+                    for batch, skey, info in staged:
+                        with hooks.tracer.span("learn"):
+                            state, metrics = self._learn(state, batch, skey)
+                        hooks.record_program_costs(
+                            "learn", self._learn, state, batch, skey,
+                            phase="learn",
+                        )
+                        td_abs = metrics.pop("priority/td_abs")
+                        infos.append(info)
+                        tds.append(np.asarray(td_abs))
+                    if self.prioritized:
+                        # ONE batched priority frame per shard per
+                        # iteration (the sample_many discipline on-wire)
+                        plane.sampler.update_priorities(infos, tds)
+                plane.supervise()
+                act_holder[0] = state
+                iteration += 1
+                env_steps += steps_per_iter
+                steps_holder[0] = env_steps
+                key, hk_key = jax.random.split(key)
+                base_build = host_metrics(metrics, recent_returns)
+
+                def build_metrics(base=base_build):
+                    # plane.gauges() polls shard stats over the wire —
+                    # deferred into the metrics callable so it runs only
+                    # when the cadence fires
+                    return dict(base(), **plane.gauges())
+
+                m_row, stop = hooks.end_iteration(
+                    iteration, env_steps, state, hk_key, build_metrics,
+                    on_metrics,
+                )
+                if m_row is not None:
+                    hooks.experience_event(**plane.telemetry_event())
+                if hooks.recovery.pending:
+                    rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
+                    state, iteration, env_steps = (
+                        rb.state, rb.iteration, rb.env_steps
+                    )
+                    # shard contents are DATA (same rationale as the
+                    # in-process rollback path); the restored state re-arms
+                    # acting and the key chain re-seeds
+                    act_holder[0] = state
+                    steps_holder[0] = env_steps
+                    key = jax.random.fold_in(key, rb.nonce)
+                    continue
+                if stop:
+                    break
+            hooks.final_checkpoint(iteration, env_steps, state)
+            return state, hooks.last_metrics
+        finally:
+            # unblock any bounded sender/sampler wait running on the
+            # staging thread FIRST, so the prefetch join below succeeds
+            # before plane.close() closes the sockets that thread is using
+            plane._stop.set()
+            if prefetch is not None:
+                prefetch.close()
+            plane.close()

@@ -258,6 +258,17 @@ class SEEDTrainer:
             else self.transport
         )
         self.worker_silence_s = float(topo.get("worker_silence_s", 120.0))
+        # sharded experience plane, FIFO chunk-relay arm (ISSUE 8,
+        # surreal_tpu/experience/): trajectory chunks route inference
+        # server -> ExperienceSender -> ReplayShardServer -> the staging
+        # thread's ShardedSampler over the negotiated experience wire —
+        # the cross-host seam that lets the learner group live on a
+        # different host than the actor fleet's server. `.get` keeps old
+        # configs loadable.
+        xp = topo.get("experience_plane", None)
+        self.experience_plane_enabled = bool(
+            xp.get("enabled", False)
+        ) if xp is not None else False
         # chaos harness: worker indices whose FIRST process spawn already
         # carried the fault plan (see _spawn_one's respawn note)
         self._fault_plan_sent: set[int] = set()
@@ -466,6 +477,7 @@ class SEEDTrainer:
         hooks = SessionHooks(self.config, self.learner)
         plane = None
         prefetch = None
+        xplane = None
         stop = threading.Event()
         try:
             state, iteration, env_steps = hooks.restore(state)
@@ -505,6 +517,71 @@ class SEEDTrainer:
             server = plane.server
             self._workers = plane.workers  # exposed for tests/fault injection
 
+            # experience-plane chunk relay (FIFO arm): a relay thread
+            # ships every assembled chunk through the ExperienceSender;
+            # the staging thread below pops from the shard tier instead
+            # of the server's in-process queue. Locally this is a
+            # loop-through; across hosts it is the learner-group seam.
+            if self.experience_plane_enabled:
+                from surreal_tpu.experience import ExperiencePlane
+
+                topo = self.config.session_config.topology
+                xplane = ExperiencePlane(
+                    kind="fifo",
+                    cfg=topo.get("experience_plane", None),
+                    trace_id=hooks.trace_id,
+                )
+
+                def relay_chunks():
+                    while not stop.is_set():
+                        try:
+                            chunk = server.chunks.get(timeout=0.5)
+                        except queue.Empty:
+                            continue
+                        chunk = dict(chunk)
+                        chunk.pop("_t_ready", None)
+                        try:
+                            xplane.sender.send_chunk(chunk)
+                        except Exception as e:
+                            # Prefetcher's discipline: a producer error is
+                            # re-raised to the consumer — a silently dead
+                            # relay would present as a misleading pop
+                            # timeout with the root cause lost
+                            relay_error.append(e)
+                            return
+
+                relay_error: list[Exception] = []
+                relay_thread = threading.Thread(
+                    target=relay_chunks, daemon=True, name="xp-relay"
+                )
+                relay_thread.start()
+
+            def next_chunk_from_xplane():
+                """Pop one chunk from the shard tier, supervising BOTH
+                planes while waiting (mirrors _DataPlane.next_chunk's
+                contract: a dead sole worker or shard must be respawned
+                while we wait, not after)."""
+                deadline = time.monotonic() + plane._timeout
+                plane._timeout = plane.steady_timeout
+                while True:
+                    if stop.is_set():
+                        raise TimeoutError("data plane stopped") from None
+                    if relay_error:
+                        raise RuntimeError(
+                            "experience-plane relay thread died"
+                        ) from relay_error[0]
+                    got = xplane.sampler.pop_chunk(timeout_s=2.0)
+                    if got is not None:
+                        rows, _n = got
+                        return rows
+                    plane.supervise()
+                    xplane.supervise()
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            "no experience chunks arriving through the "
+                            "experience plane"
+                        ) from None
+
             # double-buffered staging (learners/prefetch.py): the staging
             # thread waits on the chunk queue AND pays the host->device
             # transfer for chunk k+1 while the learner crunches chunk k —
@@ -514,7 +591,10 @@ class SEEDTrainer:
             from surreal_tpu.learners.prefetch import Prefetcher
 
             def stage_next_chunk():
-                chunk = plane.next_chunk()
+                chunk = (
+                    next_chunk_from_xplane() if xplane is not None
+                    else plane.next_chunk()
+                )
                 versions = chunk.pop("param_version")
                 n_steps = int(
                     chunk["reward"].shape[0] * chunk["reward"].shape[1]
@@ -614,6 +694,9 @@ class SEEDTrainer:
                     metrics,
                     **{"staleness/updates_behind": float(staleness)},
                     **data_plane_extras(),
+                    # cached (last-cadence) plane gauges: the wire poll
+                    # happens below at the cadence, not per iteration
+                    **(xplane.gauges(poll=False) if xplane is not None else {}),
                 )
                 m_row, stop_flag = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics
@@ -624,6 +707,9 @@ class SEEDTrainer:
                     hooks.tracer.event(
                         "hops", **hop_event(server, plane, learn_ms)
                     )
+                    if xplane is not None:
+                        xplane._poll_stats()
+                        hooks.experience_event(**xplane.telemetry_event())
                 if hooks.recovery.pending:
                     rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
                     state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
@@ -659,6 +745,13 @@ class SEEDTrainer:
             stop.set()
             if prefetch is not None:
                 prefetch.close()
+            if xplane is not None:
+                # unblock the relay's bounded sender waits and JOIN it
+                # before close() touches the DEALER sockets it shares
+                # (zmq sockets are not thread-safe)
+                xplane._stop.set()
+                relay_thread.join(timeout=5)
+                xplane.close()
             if plane is not None:
                 plane.close()
             hooks.close()

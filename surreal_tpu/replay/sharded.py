@@ -39,7 +39,14 @@ def build_replay(replay_config):
         from surreal_tpu.replay.prioritized import PrioritizedReplay
 
         return PrioritizedReplay(replay_config)
-    raise ValueError(f"unknown replay kind {kind!r}; have fifo | uniform | prioritized")
+    if kind == "remote":
+        raise ValueError(
+            "replay.kind='remote' is the sharded experience plane "
+            "(surreal_tpu/experience/) — the trainer builds it directly "
+            "(OffPolicyTrainer host path); there is no in-process replay "
+            "object to construct"
+        )
+    raise ValueError(f"unknown replay kind {kind!r}; have fifo | uniform | prioritized | remote")
 
 
 def scale_replay_config(replay_config, dp: int):

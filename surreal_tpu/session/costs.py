@@ -37,10 +37,14 @@ from __future__ import annotations
 
 from typing import Any
 
-# Documented registry of every perf/* gauge the codebase may emit.
+# Documented registry of every perf/*, replay/*, and experience/* gauge
+# the codebase may emit.
 # tests/test_import_hygiene.py::test_perf_gauges_appear_in_registry scans
-# the package source for "perf/<name>" literals and fails on any not
-# listed here. Keep descriptions current — diag and README point here.
+# the package source for whole "<prefix>/<name>" literals and fails on
+# any not listed here. Keep descriptions current — diag and README point
+# here. Per-shard detail for the experience plane rides the
+# 'experience_plane' telemetry EVENT (diag's "Experience plane" section);
+# the metrics-row gauges below are the fleet aggregates.
 GAUGE_REGISTRY = {
     "perf/mfu": (
         "model FLOP utilization over the metrics window: sum over "
@@ -54,6 +58,43 @@ GAUGE_REGISTRY = {
     "perf/flops_per_s": (
         "achieved model FLOP/s over the metrics window (the MFU numerator; "
         "emitted even when no peak spec is known for the device)."
+    ),
+    # -- replay occupancy (replay/base.py ring gauges; device scalars) ------
+    "replay/size": "absolute ring fill (transitions currently held).",
+    "replay/fill": "ring fill as a fraction of capacity.",
+    "replay/max_priority": (
+        "prioritized replay's fresh-insert priority scale (pmax-synced "
+        "across dp shards)."
+    ),
+    "replay/sample_age_frac": (
+        "mean staleness of a sampled index batch as a fraction of the "
+        "current fill (0 = just written)."
+    ),
+    # -- experience plane (surreal_tpu/experience/; fleet aggregates) -------
+    "experience/shards_live": "replay shard servers currently alive.",
+    "experience/respawns": (
+        "shard respawns performed by the plane supervisor this run."
+    ),
+    "experience/rows": "total transitions ingested across all shards.",
+    "experience/fill": "mean shard ring fill fraction.",
+    "experience/ingest_rows_per_s": (
+        "summed shard ingestion rate (the actor-fleet throughput the "
+        "plane absorbs)."
+    ),
+    "experience/wire_bytes_per_step": (
+        "shard-side wire bytes (in+out) per ingested transition — the "
+        "zero-copy success metric (control frames vs shipped arrays)."
+    ),
+    "experience/sample_queue_depth": (
+        "sample requests deferred at shards (watermark not yet ingested)."
+    ),
+    "experience/sample_wait_ms": (
+        "EWMA of the learner's wait for a prefetched iteration of "
+        "batches — ~0 means the learner never waits on experience ingest."
+    ),
+    "experience/dropped_rows": (
+        "transitions dropped after the sender's bounded retry budget "
+        "exhausted against a dead shard."
     ),
 }
 

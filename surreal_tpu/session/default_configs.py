@@ -118,7 +118,16 @@ BASE_LEARNER_CONFIG = Config(
         ),
     ),
     replay=Config(
-        kind="fifo",    # 'fifo' | 'uniform' | 'prioritized' (algo defaults override)
+        # 'fifo' | 'uniform' | 'prioritized' (algo defaults override), or
+        # 'remote' — the sharded experience plane (surreal_tpu/experience/):
+        # replay lives in ReplayShardServer processes fed by an
+        # ExperienceSender and drained by a prefetched ShardedSampler, so
+        # actor fleets on other hosts can feed one learner group. Host
+        # off-policy path only; shard geometry/transport under
+        # session.topology.experience_plane.
+        kind="fifo",
+        # remote only: the shard servers' sampling discipline
+        remote_kind="uniform",   # 'uniform' | 'prioritized'
         capacity=100_000,
         start_sample_size=1_000,
         batch_size=256,
@@ -189,6 +198,36 @@ BASE_SESSION_CONFIG = Config(
         # letting one corrupt slab slot poison the micro-batch, the acting
         # policy, and every trajectory in flight
         sanitize_obs=True,
+        # sharded experience plane (surreal_tpu/experience/): the
+        # cross-host replay tier behind replay.kind='remote' (off-policy
+        # host path) and, with enabled=true, the SEED trainer's chunk
+        # relay (trajectory chunks route server -> shard -> learner over
+        # the negotiated wire — the cross-host seam for actor fleets on
+        # other machines). Transport negotiates per peer: shm slabs
+        # same-host, the length-framed tcp codec cross-host, pickle as
+        # the fallback.
+        experience_plane=Config(
+            enabled=False,           # SEED chunk-relay arm only; the
+                                     # off-policy plane keys off replay.kind
+            num_shards=2,
+            shard_mode="thread",     # 'thread' | 'process' (spawn ctx;
+                                     # shards pin themselves to CPU — a
+                                     # replay shard must never grab a chip)
+            transport="auto",        # 'auto' | 'shm' | 'tcp' | 'pickle'
+            insert_slots=4,          # sender backpressure window (shm:
+                                     # slab slots; tcp/pickle: unacked
+                                     # frames)
+            watermark_timeout_s=5.0, # shard-side bound on sample deferral
+                                     # (a respawned-empty shard must not
+                                     # deadlock the learner)
+            ack_timeout_s=5.0,       # sender per-attempt ack budget
+            sample_timeout_s=10.0,   # sampler per-attempt reply budget
+            fifo_depth=64,           # SEED arm: chunks held per shard
+            # shard respawn schedule (the SEED worker supervisor's rule:
+            # immediate first respawn, then base * 2^k capped)
+            respawn_backoff_s=0.5,
+            respawn_backoff_cap_s=30.0,
+        ),
         # host-env (gym/dm_control) loops: collect iteration k+1 on a
         # worker thread while the device learns on k (the reference's
         # learner never waited on actors — its prefetch thread kept

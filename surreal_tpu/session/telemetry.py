@@ -86,6 +86,16 @@ line, ``t`` = unix seconds):
                     (parameter-service hop: span-tagged client fetches
                      mirrored by ParameterServer when SessionHooks owns
                      it)
+    {"type": "experience_plane", "t": ..., "kind": "...",
+     "num_shards": N, "shard_mode": "...", "transports": [...],
+     "shards": {"0": {fill, ingested_rows, samples_served,
+     ingest_transit_ms: {p50,...}, ...}, ...}, "sender": {...},
+     "sampler": {...}, ...}
+                    (the sharded experience plane's settled shape —
+                     per-shard replay gauges + sender->shard->learner
+                     hops; one per metrics row, the last one wins.
+                     surreal_tpu/experience/, rendered by diag's
+                     "Experience plane" section)
 
 Every event additionally carries ``trace`` (the run-scoped trace id
 SessionHooks mints and spawned components inherit) and ``seq`` (a
@@ -367,6 +377,7 @@ def diag_summary(folder: str) -> dict | None:
     health: dict[str, dict] = {}
     compile_cache = None
     data_plane = None
+    experience = None
     trace_id = None
     programs: dict[str, dict] = {}   # program_cost events (last per name)
     precision = None                 # last 'precision' event (active policy)
@@ -412,6 +423,12 @@ def diag_summary(folder: str) -> dict | None:
             # the last event is the settled negotiation (SEED drivers emit
             # one after the first learn and one at run end)
             data_plane = {
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "experience_plane":
+            # the last event is the settled plane shape (one per metrics
+            # row while a sharded experience plane is active)
+            experience = {
                 k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
         elif ev.get("type") == "tune":
@@ -522,6 +539,7 @@ def diag_summary(folder: str) -> dict | None:
         "health": health,
         "compile_cache": compile_cache,
         "data_plane": data_plane,
+        "experience": experience,
         "tune": tune,
         "tune_hits": tune_hits,
         "tune_misses": tune_misses,
@@ -597,6 +615,9 @@ def diag_report(folder: str) -> str | None:
             "Data plane — "
             + ", ".join(f"{k}={dpl[k]}" for k in sorted(dpl)),
         ]
+    xp_lines = _experience_plane_lines(s)
+    if xp_lines:
+        lines += ["", "Experience plane"] + xp_lines
     tn = s.get("tune")
     if tn is not None:
         cfg = tn.get("config") or {}
@@ -700,6 +721,53 @@ def diag_report(folder: str) -> str | None:
     else:
         lines.append("  (none recorded — single-host session)")
     return "\n".join(lines)
+
+
+def _experience_plane_lines(s: dict) -> list[str]:
+    """The diag 'Experience plane' section: shard geometry/transport mix,
+    per-shard replay gauges (fill, ingested rows, samples served, sample
+    queue depth), the learner's sample-wait, and per-hop
+    sender->shard->learner percentiles from the last ``experience_plane``
+    event. Empty list when the session ran no plane."""
+    xp = s.get("experience")
+    if not xp:
+        return []
+    lines = [
+        f"  {xp.get('kind', '?')} x {xp.get('num_shards', '?')} shards "
+        f"({xp.get('shard_mode', '?')} mode), transports "
+        f"{xp.get('transports', [])}",
+        f"  wire {xp.get('wire_bytes_per_step', 0):.1f} B/step, learner "
+        f"sample-wait {xp.get('sample_wait_ms', 0):.2f} ms (EWMA)",
+    ]
+    shards = xp.get("shards") or {}
+    if shards:
+        lines.append(
+            f"  {'shard':>6} {'fill':>7} {'rows':>10} {'samples':>9} "
+            f"{'queue':>6} {'ingest p50/p90/p99 ms':>24}"
+        )
+        for sid in sorted(shards, key=lambda x: int(x)):
+            sh = shards[sid]
+            tr = sh.get("ingest_transit_ms") or {}
+            hop = (
+                f"{tr.get('p50', 0):.2f}/{tr.get('p90', 0):.2f}/"
+                f"{tr.get('p99', 0):.2f}" if tr else "n/a"
+            )
+            lines.append(
+                f"  {sid:>6} {float(sh.get('fill', 0)):>7.2f} "
+                f"{int(sh.get('ingested_rows', 0)):>10} "
+                f"{int(sh.get('samples_served', 0)):>9} "
+                f"{int(sh.get('sample_queue_depth', 0)):>6} {hop:>24}"
+            )
+    snd = xp.get("sender") or {}
+    smp = xp.get("sampler") or {}
+    if snd or smp:
+        lines.append(
+            "  sender: "
+            + ", ".join(f"{k}={snd[k]:g}" for k in sorted(snd))
+            + " | sampler: "
+            + ", ".join(f"{k}={smp[k]:g}" for k in sorted(smp))
+        )
+    return lines
 
 
 def _performance_lines(s: dict) -> list[str]:

@@ -45,6 +45,20 @@ Sites and the kinds they honor:
                          (``delay_reply``: sleep ``ms`` before replying —
                          drives client timeouts; REQ/REP forbids a true
                          drop, the REP socket must answer to recover)
+    experience.shard     once per replay-shard-server loop pass
+                         (``kill_shard``: raise FaultInjected — the
+                         plane supervisor must respawn the shard while
+                         the learner keeps training on survivors;
+                         ``delay``: sleep ``ms``)
+    experience.sample    every served shard sample/pop
+                         (``delay_sample``: sleep ``ms`` before serving —
+                         drives the sampler's bounded retry and the
+                         sample-wait gauge)
+    experience.send      every ExperienceSender wire frame
+                         (``corrupt_wire_frame``: scramble the outgoing
+                         frame bytes — the shard must count+drop it and
+                         the ack retry must redeliver; ``drop_frame`` /
+                         ``delay_frame`` as on transport.send)
 
 Config wiring: ``session_config.faults.plan`` (a list of spec dicts, or a
 JSON string of one for ``--set`` CLI overrides). Drivers call
@@ -79,6 +93,9 @@ SITES = frozenset(
         "transport.send",
         "server.serve",
         "param_service.reply",
+        "experience.shard",
+        "experience.sample",
+        "experience.send",
     }
 )
 

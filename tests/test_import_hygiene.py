@@ -272,16 +272,25 @@ _DATA_PLANE_STEADY_STATE = (
     "distributed/env_worker.py",
     "distributed/inference_server.py",
     "launch/seed_trainer.py",
+    # the experience plane's steady-state modules (ISSUE 8): every
+    # encode/decode routes through experience/wire.py — the negotiated
+    # fallback codec is the ONLY place the plane may unpickle
+    "experience/shard.py",
+    "experience/sender.py",
+    "experience/sampler.py",
+    "experience/plane.py",
+    "launch/offpolicy_trainer.py",
 )
 
 
 def test_data_plane_pickles_only_in_fallback_codec():
-    """Data-plane serialization lint (the shm-transport PR's invariant):
-    ``pickle.dumps``/``pickle.loads`` of ndarray payloads may appear only
-    in the fallback transport module and control-frame codec
-    (``distributed/shm_transport.py``) — never in the steady-state
-    serve/step loops, which must route every encode/decode through the
-    codec so the transport decision stays in one place."""
+    """Data-plane serialization lint (the shm-transport PR's invariant,
+    extended over the experience plane): ``pickle.dumps``/``pickle.loads``
+    of ndarray payloads may appear only in the fallback transport modules
+    and control-frame codecs (``distributed/shm_transport.py``,
+    ``experience/wire.py``) — never in the steady-state serve/step loops,
+    which must route every encode/decode through the codec so the
+    transport decision stays in one place."""
     banned = ("pickle.dumps(", "pickle.loads(", "import pickle")
     bad = []
     for rel in _DATA_PLANE_STEADY_STATE:
@@ -290,14 +299,16 @@ def test_data_plane_pickles_only_in_fallback_codec():
             if b in src:
                 bad.append(f"{rel}: {b}")
     assert not bad, (
-        "ndarray pickling belongs to distributed/shm_transport.py (the "
-        "fallback codec), not the steady-state data-plane loops:\n"
+        "ndarray pickling belongs to the fallback codecs "
+        "(distributed/shm_transport.py, experience/wire.py), not the "
+        "steady-state data-plane loops:\n"
         + "\n".join(bad)
     )
-    codec = (_PKG_ROOT / "distributed/shm_transport.py").read_text()
-    assert "pickle.dumps(" in codec and "pickle.loads(" in codec, (
-        "the fallback codec moved out of shm_transport.py; update this lint"
-    )
+    for codec_rel in ("distributed/shm_transport.py", "experience/wire.py"):
+        codec = (_PKG_ROOT / codec_rel).read_text()
+        assert "pickle.dumps(" in codec and "pickle.loads(" in codec, (
+            f"the fallback codec moved out of {codec_rel}; update this lint"
+        )
 
 
 _SUPERVISED_PACKAGES = ("distributed", "launch")
@@ -334,18 +345,20 @@ def test_no_swallowed_exceptions_in_supervised_code():
 
 
 def test_perf_gauges_appear_in_registry():
-    """Gauge-registry lint (ISSUE 6 satellite): every ``perf/*`` gauge
-    name emitted anywhere in the package must appear in the documented
-    registry (``session/costs.py::GAUGE_REGISTRY``) — an undocumented
-    gauge is invisible to diag readers and to the README's knob table.
-    The scan covers string literals, so a gauge built by concatenation
-    would dodge it; our style writes metric names as whole literals (the
+    """Gauge-registry lint (ISSUE 6 satellite, extended by ISSUE 8 over
+    the replay/experience families): every ``perf/*``, ``replay/*``, or
+    ``experience/*`` gauge name emitted anywhere in the package must
+    appear in the documented registry
+    (``session/costs.py::GAUGE_REGISTRY``) — an undocumented gauge is
+    invisible to diag readers and to the README's knob table. The scan
+    covers string literals, so a gauge built by concatenation would dodge
+    it; our style writes metric names as whole literals (the
     donation/unroll lints rely on the same convention)."""
     import re
 
     from surreal_tpu.session.costs import GAUGE_REGISTRY
 
-    lit = re.compile(r"[\"'](perf/[a-z0-9_]+)[\"']")
+    lit = re.compile(r"[\"']((?:perf|replay|experience)/[a-z0-9_]+)[\"']")
     bad = []
     for path in sorted(_PKG_ROOT.rglob("*.py")):
         if path.name == "costs.py":
@@ -358,12 +371,12 @@ def test_perf_gauges_appear_in_registry():
                     f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
                 )
     assert not bad, (
-        "perf/* gauges emitted but not documented in "
+        "perf/replay/experience gauges emitted but not documented in "
         "session/costs.py::GAUGE_REGISTRY:\n" + "\n".join(bad)
     )
     # and the registry names must parse as gauge literals themselves
     for name in GAUGE_REGISTRY:
-        assert name.startswith("perf/"), name
+        assert name.startswith(("perf/", "replay/", "experience/")), name
 
 
 def test_graft_entry_import_initializes_no_backend():
