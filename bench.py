@@ -279,6 +279,13 @@ def main() -> int:
         from perf_wallclock import experience_plane_main
 
         return experience_plane_main(sys.argv[1:])
+    if "--act-path" in sys.argv:
+        # serving-tier campaign (ISSUE 10): 1 vs N inference replicas +
+        # parameter-fanout bytes-per-publish arms — writes BENCH_act.json
+        # (perf_gate's act gate consumes it)
+        from perf_wallclock import act_path_main
+
+        return act_path_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

@@ -70,6 +70,7 @@ def load_rows(art_dir: str) -> list[dict]:
             rows.append({"file": name, "round": int(m.group(1)),
                          "failed": True})
             continue
+        ne, hz = parsed.get("num_envs"), parsed.get("horizon")
         rows.append({
             "file": name,
             "round": int(m.group(1)),
@@ -78,6 +79,13 @@ def load_rows(art_dir: str) -> list[dict]:
             "platform": parsed.get("platform"),
             "device": parsed.get("device"),
             "mfu": parsed.get("mfu"),
+            # measurement geometry + arm (bench.py records both since
+            # ISSUE 7): rows from different geometries/policy arms must
+            # never silently read as comparable — the r06 row is a bf16
+            # 512x64 CPU arm, not a headline regression. None = the
+            # artifact predates the fields.
+            "geometry": f"{ne}x{hz}" if ne and hz else None,
+            "arm": parsed.get("precision"),
             "failed": False,
         })
     rows.sort(key=lambda r: r["round"])
@@ -85,10 +93,18 @@ def load_rows(art_dir: str) -> list[dict]:
 
 
 def fingerprint(row: dict) -> tuple:
+    # geometry + precision arm joined the fingerprint with the ISSUE-10
+    # table fix: a row measured at a different geometry or policy arm is
+    # a different workload, and gating it against the headline rows is
+    # exactly the cross-geometry misread the per-row fields exist to
+    # prevent (rows predating the fields compare among themselves via
+    # the 'unrecorded' bucket, as platform/device already did)
     return (
         row.get("metric"),
         row.get("platform") or "unrecorded",
         row.get("device") or "unrecorded",
+        row.get("geometry") or "unrecorded",
+        row.get("arm") or "unrecorded",
     )
 
 
@@ -235,10 +251,84 @@ def gate_experience(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_act(art_dir: str, out=sys.stdout) -> int:
+    """Act-serving-tier gate (ISSUE 10): when a committed
+    ``BENCH_act.json`` exists (``bench.py --act-path``), enforce the
+    tier's two commitments on the image it was measured on:
+
+    - replication does not collapse throughput: the N-replica arm's env
+      steps/s stay >= ``act_honesty_ratio`` x the single-replica arm.
+      The bound is the artifact's own (0.5 on a one-core box, where the
+      fleet's N serve threads run SERIALLY — each round pays N small
+      forwards instead of one coalesced one, and the serve threads
+      contend with the learner for the core; the >= 1x SCALING claim is
+      cross-core and waits on a multi-core measurement round);
+    - fanout bytes: the delta AND bf16 arms' steady bytes-per-publish
+      sit BELOW the full-f32 broadcast frame (which itself replaces N
+      per-client fetch blobs with one encode).
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_act.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_act.json — act-serving tier not "
+              "measured (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_act.json is from a FAILED campaign (rc 0)",
+              file=out)
+        return 0
+    rc = 0
+    single = data.get("single") or {}
+    fleet = data.get("fleet") or {}
+    # default mirrors the producer's bound (perf_wallclock.py
+    # ACT_HONESTY_RATIO) so a field-less artifact can't flip the verdict
+    honesty = float(data.get("act_honesty_ratio", 0.5))
+    s_sps, f_sps = single.get("env_steps_per_s"), fleet.get("env_steps_per_s")
+    # `is not None`, not truthiness: a MEASURED 0.0 (total collapse) must
+    # gate red, not silently skip the check
+    if s_sps is not None and f_sps is not None and float(s_sps) > 0:
+        ratio = float(f_sps) / float(s_sps)
+        line = (
+            f"perf_gate: act-path {fleet.get('replicas')}-replica "
+            f"{float(f_sps):,.1f} vs single {float(s_sps):,.1f} steps/s "
+            f"(ratio {ratio:.3f}, commitment >= {honesty:.2f} on a "
+            f"one-core box)"
+        )
+        if ratio < honesty:
+            print(line + " — TIER COLLAPSES THROUGHPUT", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    arms = (data.get("fanout") or {}).get("arms") or {}
+    full = (arms.get("full_f32") or {}).get("bytes_per_publish")
+    if full is not None:
+        for arm in ("delta", "bf16"):
+            got = (arms.get(arm) or {}).get("bytes_per_publish")
+            if got is None:
+                continue
+            line = (
+                f"perf_gate: fanout {arm} {float(got):,.1f} B/publish vs "
+                f"full-f32 {float(full):,.1f} (commitment: below)"
+            )
+            if float(got) >= float(full):
+                print(line + " — NOT BELOW", file=out)
+                rc = 1
+            else:
+                print(line + " — ok", file=out)
+    return rc
+
+
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
-    # the experience-plane gate is independent of the BENCH_r* trail:
-    # run it first and fold its verdict into every return path
-    xp_rc = gate_experience(art_dir, out=out)
+    # the experience-plane and act-path gates are independent of the
+    # BENCH_r* trail: run them first and fold their verdicts into every
+    # return path
+    xp_rc = max(
+        gate_experience(art_dir, out=out), gate_act(art_dir, out=out)
+    )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]
     if not rows:

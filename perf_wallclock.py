@@ -472,6 +472,258 @@ def experience_plane_main(argv) -> int:
     return 0
 
 
+# -- autoscaling act-serving tier (--act-path) --------------------------------
+
+ACT_WORKERS = 2
+ACT_WORKER_ENVS = 8
+ACT_HORIZON = 32
+ACT_WARM = 3
+ACT_MEAS = 12
+ACT_REPLICAS = 2
+# the one-core honesty bound gate_act enforces. On a box with ONE core
+# the N-replica arm cannot win: the fleet splits each lockstep round's
+# single coalesced forward into N SERIAL smaller forwards (per-dispatch
+# overhead dominates a small CPU MLP act), and the extra serve thread
+# contends with the learner for the same core — measured ~0.67x at this
+# geometry. The local commitment is therefore "replication does not
+# COLLAPSE throughput" (>= 0.5x single); the >= 1x scaling claim needs
+# cores for the replicas to actually run on, recorded when a multi-core
+# measurement round exists.
+ACT_HONESTY_RATIO = 0.5
+FANOUT_PUBLISHES = 12
+FANOUT_HIDDEN = (256, 256)  # big enough that frame bytes dominate headers
+
+
+def _act_measure(replicas: int) -> dict:
+    """One SEED run at the act-path geometry with ``replicas`` inference
+    servers; returns the row with serve p50/p99 from the session's own
+    ``hops`` telemetry (the PR-1/PR-6 gauges, not a bench-side timer)."""
+    import shutil
+    import tempfile
+
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+    from surreal_tpu.session.telemetry import diag_summary
+
+    folder = tempfile.mkdtemp(prefix="bench_act_")
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="impala", horizon=ACT_HORIZON),
+        ),
+        env_config=Config(name="gym:CartPole-v1", num_envs=ACT_WORKER_ENVS),
+        session_config=Config(
+            folder=folder,
+            total_env_steps=10**12,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(
+                num_env_workers=ACT_WORKERS,
+                inference_fleet=Config(replicas=replicas),
+            ),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg)
+    marks: list[tuple[float, float]] = []
+    last: dict = {}
+
+    def on_m(it, m):
+        marks.append((time.perf_counter(), m["time/env_steps"]))
+        last.update(m)
+        return len(marks) >= ACT_WARM + ACT_MEAS
+
+    try:
+        trainer.run(on_metrics=on_m)
+        hops = (diag_summary(folder) or {}).get("hops") or {}
+    finally:
+        shutil.rmtree(folder, ignore_errors=True)
+    t0, s0 = marks[ACT_WARM - 1]
+    t1, s1 = marks[-1]
+    n = len(marks) - ACT_WARM
+    serve = hops.get("serve_batch_ms") or {}
+    return {
+        "replicas": replicas,
+        "env_steps_per_s": round((s1 - s0) / (t1 - t0), 1),
+        "iter_ms": round((t1 - t0) / n * 1e3, 2),
+        "serve_ms_p50": serve.get("p50"),
+        "serve_ms_p99": serve.get("p99"),
+        "serve_ms_ewma": last.get("server/serve_ms"),
+        "chunk_age_s": last.get("server/chunk_age_s"),
+        "replicas_live": last.get("fleet/replicas_live"),
+        "tuning": trainer.tune_decision.artifact(),
+    }
+
+
+def _fanout_measure() -> dict:
+    """Bytes-per-publish across the fanout arms, against the
+    point-to-point baseline (one full msgpack blob per fetch — what
+    every subscriber used to cost PER CLIENT). Versions simulate SGD
+    steps (small fixed-seed perturbations); the steady figure excludes
+    the first (necessarily full) key frame."""
+    import numpy as np
+
+    from surreal_tpu.agents import make_agent
+    from surreal_tpu.distributed.module_dict import dumps_pytree
+    from surreal_tpu.distributed.param_fanout import (
+        ParameterFanout,
+        ParameterSubscriber,
+    )
+    from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+    from surreal_tpu.learners import build_learner
+    from surreal_tpu.session.config import Config
+
+    import jax
+
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(24,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(4,), dtype=np.dtype(np.float32)),
+    )
+    learner = build_learner(
+        Config(algo=Config(name="ppo"),
+               model=Config(actor_hidden=FANOUT_HIDDEN,
+                            critic_hidden=FANOUT_HIDDEN)),
+        specs,
+    )
+    state = learner.init(jax.random.key(0))
+    view = make_agent(learner).acting_view(state)
+    baseline_bytes = len(dumps_pytree(view))
+    leaves = [np.asarray(l) for l in jax.device_get(jax.tree.leaves(view))]
+    rng = np.random.default_rng(0)
+
+    def version_stream():
+        """Successive acting views one small SGD-sized step apart."""
+        cur = [np.array(l) for l in leaves]
+        treedef = jax.tree.structure(view)
+        while True:
+            yield jax.tree.unflatten(treedef, cur)
+            cur = [
+                (l + 1e-3 * rng.standard_normal(l.shape).astype(l.dtype))
+                if np.issubdtype(l.dtype, np.floating) else l
+                for l in cur
+            ]
+
+    arms = {}
+    for name, wire, delta in (
+        ("full_f32", "f32", False),
+        ("delta", "f32", True),
+        ("bf16", "bf16", False),
+        ("delta_bf16", "bf16", True),
+    ):
+        fan = ParameterFanout(wire=wire, delta=delta)
+        sub = ParameterSubscriber(fan.address, fan.ack_address, view)
+        time.sleep(0.3)  # SUB join
+        stream = version_stream()
+        sizes = []
+        err = 0.0
+        params = None
+        for k in range(FANOUT_PUBLISHES):
+            params = next(stream)
+            info = fan.publish(params)
+            sizes.append(info["bytes"])
+            deadline = time.time() + 5.0
+            while sub.version < info["version"] and time.time() < deadline:
+                sub.poll(timeout_ms=50)
+            time.sleep(0.02)  # let the ack land before the next publish
+        got = jax.tree.leaves(sub.params)
+        want = jax.tree.leaves(params)
+        err = max(
+            float(np.max(np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64))))
+            for a, b in zip(got, want)
+        )
+        arms[name] = {
+            "wire": wire,
+            "delta": delta,
+            "first_frame_bytes": sizes[0],
+            "bytes_per_publish": round(
+                sum(sizes[1:]) / max(len(sizes) - 1, 1), 1
+            ),
+            "frames": dict(full=fan.full_frames, delta=fan.delta_frames,
+                           rekeys=fan.rekeys),
+            "reconstruct_abs_err_max": err,
+            "subscriber_applied": sub.applied,
+        }
+        sub.close()
+        fan.close()
+    return {
+        "pointtopoint_fetch_bytes": baseline_bytes,
+        "publishes_per_arm": FANOUT_PUBLISHES,
+        "model_hidden": list(FANOUT_HIDDEN),
+        "arms": arms,
+    }
+
+
+def act_path_main(argv) -> int:
+    """--act-path driver (ISSUE 10): the serving-tier campaign —
+    1 vs N inference-server replicas through the real SEED trainer at a
+    one-core-feasible geometry (serve p50/p99 + env steps/s), plus
+    bytes-per-publish for the parameter-fanout arms (full f32 / delta /
+    bf16 / delta+bf16) against the point-to-point fetch baseline.
+    Writes BENCH_act.json (perf_gate.gate_act and PERF.md's generated
+    section consume it), with bench.py's bounded retry/backoff and
+    structured failed-round artifact."""
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_act.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    try:
+        import gymnasium  # noqa: F401
+    except Exception as e:
+        result = {"error": f"gymnasium unavailable: {e}", "parsed": None}
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+        return 0
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            single = _act_measure(1)
+            fleet = _act_measure(ACT_REPLICAS)
+            fanout = _fanout_measure()
+            result = {
+                "metric": "act_path_env_steps_per_sec_seed_cartpole",
+                "value": fleet["env_steps_per_s"],
+                "unit": "env_steps/s",
+                "geometry": (
+                    f"{ACT_WORKERS} thread workers x {ACT_WORKER_ENVS} "
+                    f"gym:CartPole-v1 envs x {ACT_HORIZON} horizon, "
+                    f"1 vs {ACT_REPLICAS} inference-server replicas"
+                ),
+                "act_honesty_ratio": ACT_HONESTY_RATIO,
+                "single": single,
+                "fleet": fleet,
+                "fanout": fanout,
+                # the device actually measured (bench.py discipline)
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"act-path attempt {attempt + 1}/{RETRY_ATTEMPTS} failed "
+                    f"({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -481,6 +733,8 @@ def main(argv=None) -> None:
         sys.exit(host_path_main(argv))
     if "--experience-plane" in argv:
         sys.exit(experience_plane_main(argv))
+    if "--act-path" in argv:
+        sys.exit(act_path_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

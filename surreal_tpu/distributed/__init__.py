@@ -8,7 +8,12 @@ parameter pub/sub for host consumers, and the binary wire format.
 """
 
 from surreal_tpu.distributed.env_worker import run_env_worker
+from surreal_tpu.distributed.fleet import InferenceFleet
 from surreal_tpu.distributed.inference_server import InferenceServer
+from surreal_tpu.distributed.param_fanout import (
+    ParameterFanout,
+    ParameterSubscriber,
+)
 from surreal_tpu.distributed.shm_transport import (
     SlabSpec,
     negotiate_worker_transport,
@@ -27,7 +32,10 @@ from surreal_tpu.distributed.param_service import (
 
 __all__ = [
     "run_env_worker",
+    "InferenceFleet",
     "InferenceServer",
+    "ParameterFanout",
+    "ParameterSubscriber",
     "SlabSpec",
     "negotiate_worker_transport",
     "ModuleDict",

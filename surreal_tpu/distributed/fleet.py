@@ -1,0 +1,461 @@
+"""InferenceFleet: the autoscaling act-serving tier (ISSUE 10 tentpole).
+
+The SEED serving path was one :class:`InferenceServer` — one coalescing
+window, one serve thread, one process-wide bottleneck once PR 8 made
+experience ingest never-blocking. This module replicates it: N servers
+behind session-affinity routing, the shape RollArt's disaggregated
+actor/learner/inference design argues for (arXiv:2512.22560) and the
+large-batch act-throughput discipline of Accelerated Methods
+(arXiv:1803.02811) sizes.
+
+Design points:
+
+- **Session affinity** — workers hash to a replica at spawn and stay
+  there (``address_for``), so per-(ident, slot) trajectory streams and
+  negotiated shm slabs keep a single owner. Routing is rendezvous
+  (highest-random-weight) hashing over the ALIVE replica set: a replica
+  death remaps only ITS workers onto survivors, and a scale-up steals
+  only the share that hashes to the new replica — crc32 of fixed-width
+  encodings (the ``experience/sender.py`` rule: ASCII-digit crc32 is
+  pathologically unbalanced mod small counts).
+- **Per-replica coalescing budgets** — each replica's ``min_batch`` is
+  its OWN expected worker count from the affinity map (the single-server
+  path tuned to the global fleet size), and ``auto_tune`` keeps tracking
+  per-replica liveness from there — one forward per lockstep round per
+  replica, through death and respawn.
+- **Lifecycle** — the PR-5 respawn machinery: a dead replica (serve
+  thread gone — e.g. the ``fleet.replica`` ``kill_replica`` chaos site)
+  is closed (slab release) and respawned at its FIXED address under the
+  exponential base*2^k backoff schedule with healthy-streak reset.
+  While it is down, its workers' requests time out, the workers die,
+  and the worker supervisor respawns them against ``address_for`` —
+  which now routes to survivors (re-hello to survivors, chaos-tested).
+- **Autoscaling** — scale decisions ride the PR-1 gauges: the fleet-mean
+  serve-latency EWMA above ``scale_up_serve_ms`` (serving is the
+  bottleneck) adds a replica up to ``max_replicas``; below
+  ``scale_down_serve_ms`` with more than ``min_replicas`` alive, the
+  replica with the fewest live workers is drained (closed — its workers
+  re-route on respawn, the same survivors path). Decisions are
+  cooldown-bounded and counted (``fleet/scale_ups``/``fleet/scale_downs``).
+
+Parameter distribution for the tier is the fanout plane
+(``distributed/param_fanout.py``); in-process replicas share the act
+closure directly via :meth:`set_act_fn` (broadcast, version-synced).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import zlib
+from typing import Callable
+
+from surreal_tpu.distributed.inference_server import InferenceServer
+from surreal_tpu.utils.net import alloc_address as _alloc_address
+
+
+def _rendezvous_score(worker_id: int, replica: int) -> int:
+    """Highest-random-weight score for (worker, replica) — fixed-width
+    little-endian crc32 (stable across processes, unlike builtin hash)."""
+    return zlib.crc32(
+        int(worker_id).to_bytes(8, "little")
+        + int(replica).to_bytes(8, "little")
+    )
+
+
+class InferenceFleet:
+    """N replicated :class:`InferenceServer`s with session-affinity
+    routing, per-replica coalescing budgets, respawn/backoff lifecycle,
+    and gauge-driven autoscaling. Exposes the single-server surface the
+    SEED loop consumes (``chunks``/``set_act_fn``/``version``/
+    ``queue_stats``/``episode_stats``/``transport_stats``/``hop_stats``/
+    ``address_for``/``close``) so the trainer is tier-size-agnostic."""
+
+    # a respawn that survives this long clears its replica's failure
+    # streak (the PR-5 rule: backoff targets crash LOOPS)
+    _HEALTHY_S = 10.0
+
+    def __init__(
+        self,
+        act_fn: Callable,
+        *,
+        num_workers: int,
+        replicas: int = 2,
+        unroll_length: int = 32,
+        max_wait_ms: float = 5.0,
+        transport: str = "auto",
+        sanitize_obs: bool = True,
+        trace_id: str | None = None,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        autoscale: bool = False,
+        scale_up_serve_ms: float = 40.0,
+        scale_down_serve_ms: float = 5.0,
+        scale_cooldown_s: float = 30.0,
+        respawn_backoff_s: float = 0.5,
+        respawn_backoff_cap_s: float = 30.0,
+    ):
+        if replicas < 1:
+            raise ValueError(f"inference_fleet.replicas must be >= 1, got {replicas}")
+        self._act_fn = act_fn
+        self._version = 0
+        self.num_workers = int(num_workers)
+        self.trace_id = trace_id
+        # ONE shared output queue for every replica (injected at spawn):
+        # the trainer's chunk wait stays a native blocking get — no
+        # facade polling — and queue-full eviction prefers the oldest
+        # chunk fleet-wide, the same 64-chunk learner backlog the single
+        # server bounds
+        self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
+        self._server_kwargs = dict(
+            unroll_length=unroll_length,
+            max_wait_ms=max_wait_ms,
+            transport=transport,
+            auto_tune=True,  # per-replica budgets track per-replica liveness
+            sanitize_obs=sanitize_obs,
+            trace_id=trace_id,
+            chunks=self.chunks,
+        )
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.autoscale = bool(autoscale)
+        self.scale_up_serve_ms = float(scale_up_serve_ms)
+        self.scale_down_serve_ms = float(scale_down_serve_ms)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        self.respawns = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.respawn_backoff_s = 0.0  # gauge: backoff set by last respawn
+        self._last_scale_at = time.monotonic()
+        # replica slot i: fixed address + server (None = drained by a
+        # scale-down; a dead-but-not-drained server stays until respawn)
+        n = min(max(int(replicas), self.min_replicas), self.max_replicas)
+        self._addresses = [_alloc_address() for _ in range(n)]
+        self._replicas: list[InferenceServer | None] = []
+        # the shared respawn state machine (utils/respawn.py): immediate
+        # first respawn, base * 2^k capped, healthy-streak reset
+        from surreal_tpu.utils.respawn import RespawnSchedule
+
+        self._sched = RespawnSchedule(
+            n, respawn_backoff_s, respawn_backoff_cap_s,
+            healthy_s=self._HEALTHY_S,
+        )
+        # supervision runs from the staging thread's empty-poll waits AND
+        # the trainer thread (the _DataPlane rule): one lock
+        self._lock = threading.Lock()
+        for i in range(n):
+            self._replicas.append(self._spawn(i))
+        self._rebalance_budgets()
+
+    # -- replica lifecycle ---------------------------------------------------
+    def _spawn(self, i: int) -> InferenceServer:
+        return InferenceServer(
+            act_fn=self._act_fn,
+            bind=self._addresses[i],
+            min_batch=1,  # _rebalance_budgets installs the affinity share
+            version=self._version,
+            **self._server_kwargs,
+        )
+
+    def servers(self) -> list[InferenceServer]:
+        """Alive replicas, slot order (drained/dead ones excluded)."""
+        return [
+            s for s in self._replicas if s is not None and s.alive
+        ]
+
+    def _alive_slots(self) -> list[int]:
+        return [
+            i for i, s in enumerate(self._replicas)
+            if s is not None and s.alive
+        ]
+
+    def replica_of(self, worker_id: int) -> int:
+        """Session-affinity route: rendezvous-hash ``worker_id`` over the
+        alive replica slots. With NOTHING alive, hash over the slots the
+        supervisor will actually respawn (non-drained) — a scale-down's
+        drained slot never rebinds its address, so routing a worker there
+        would churn it against a permanently dead port instead of riding
+        out a respawn backoff."""
+        alive = self._alive_slots()
+        if not alive:
+            alive = [
+                i for i, s in enumerate(self._replicas) if s is not None
+            ] or list(range(len(self._addresses)))
+        return max(alive, key=lambda r: _rendezvous_score(worker_id, r))
+
+    def address_for(self, worker_id: int) -> str:
+        return self._addresses[self.replica_of(worker_id)]
+
+    def _affinity_counts(self) -> dict[int, int]:
+        """{alive slot -> worker count} under the current affinity map —
+        one accounting for the coalescing budgets AND the scale-down
+        victim choice (they must agree)."""
+        counts = {i: 0 for i in self._alive_slots()}
+        for w in range(self.num_workers):
+            r = self.replica_of(w)
+            if r in counts:
+                counts[r] += 1
+        return counts
+
+    def _rebalance_budgets(self) -> None:
+        """Install each replica's coalescing budget = its affinity share
+        of the worker fleet (min_batch per REPLICA, not the global count;
+        auto_tune tracks per-replica liveness from here)."""
+        for i, c in self._affinity_counts().items():
+            srv = self._replicas[i]
+            if srv is not None:
+                srv.min_batch = max(1, c)
+
+    def supervise(self) -> None:
+        """Respawn dead replicas in place (fixed address) under the
+        exponential-backoff schedule; a respawn that stays healthy clears
+        its streak. Drained slots (scale-down) are left alone."""
+        with self._lock:
+            now = time.monotonic()
+            for i, srv in enumerate(self._replicas):
+                if srv is None:
+                    continue  # drained by a scale-down
+                if srv.alive:
+                    self._sched.note_alive(i, now)
+                    continue
+                if not self._sched.due(i, now):
+                    continue  # backing off a crash-looping replica
+                # release the crashed replica's slabs/socket before the
+                # in-place rebind (its loop's finally closed the socket;
+                # close() joins the dead thread and unlinks every slab)
+                srv.close()
+                self._replicas[i] = self._spawn(i)
+                self.respawns += 1
+                self.respawn_backoff_s = self._sched.respawned(i, now)
+                self._rebalance_budgets()
+
+    # -- autoscaling ---------------------------------------------------------
+    def _serve_ms_mean(self) -> float | None:
+        ewmas = [
+            s._serve_ms_ewma for s in self.servers()
+            if s._serve_ms_ewma is not None
+        ]
+        return sum(ewmas) / len(ewmas) if ewmas else None
+
+    def maybe_autoscale(self) -> str | None:
+        """One scale decision per call (the metrics cadence), gated by
+        the cooldown: 'up', 'down', or None. Driven by the fleet-mean
+        serve-latency EWMA — the PR-1 gauge that says whether SERVING is
+        the bottleneck (queue depth/chunk age say the learner is)."""
+        if not self.autoscale:
+            return None
+        now = time.monotonic()
+        if now - self._last_scale_at < self.scale_cooldown_s:
+            return None
+        serve_ms = self._serve_ms_mean()
+        if serve_ms is None:
+            return None
+        alive = self._alive_slots()
+        if serve_ms > self.scale_up_serve_ms and len(alive) < self.max_replicas:
+            self.scale_up()
+            self._last_scale_at = now
+            return "up"
+        if (
+            serve_ms < self.scale_down_serve_ms
+            and len(alive) > self.min_replicas
+        ):
+            self.scale_down()
+            self._last_scale_at = now
+            return "down"
+        return None
+
+    def scale_up(self) -> int:
+        """Add one replica. Prefers re-arming a drained slot (its fixed
+        address is already allocated); otherwise appends a new slot.
+        Only NEW/respawned workers route to it (session affinity —
+        connected workers never migrate mid-stream)."""
+        with self._lock:
+            for i, srv in enumerate(self._replicas):
+                if srv is None:
+                    self._replicas[i] = self._spawn(i)
+                    break
+            else:
+                self._addresses.append(_alloc_address())
+                self._sched.add_slot()
+                self._replicas.append(self._spawn(len(self._replicas)))
+                i = len(self._replicas) - 1
+            self.scale_ups += 1
+            self._rebalance_budgets()
+            return i
+
+    def scale_down(self) -> int | None:
+        """Drain the alive replica with the fewest live workers: close it
+        (slab release; half-built chunks on it are lost — bounded, like a
+        replica crash) and leave the slot empty. Its workers' next reply
+        wait times out, they die, and the worker supervisor respawns them
+        against a survivor (the re-hello-to-survivors path)."""
+        with self._lock:
+            alive = self._alive_slots()
+            if len(alive) <= self.min_replicas:
+                return None
+            counts = self._affinity_counts()
+            victim = min(alive, key=lambda i: (counts[i], -i))
+            srv = self._replicas[victim]
+            self._replicas[victim] = None
+            self.scale_downs += 1
+        # close OUTSIDE the lock: it joins the serve thread (bounded 2 s)
+        if srv is not None:
+            srv.close()
+        self._rebalance_budgets()
+        return victim
+
+    # -- single-server surface (what the SEED loop consumes) -----------------
+    def set_act_fn(self, act_fn: Callable) -> None:
+        """Broadcast the new policy to every alive replica (each bumps
+        its own version; the fleet counter is the source of truth a
+        respawned replica is re-synced from)."""
+        self._act_fn = act_fn
+        self._version += 1
+        for srv in self.servers():
+            srv.set_act_fn(act_fn)
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def episode_stats(self) -> dict[str, float] | None:
+        stats = [s.episode_stats() for s in self.servers()]
+        stats = [s for s in stats if s]
+        if not stats:
+            return None
+        # mean of per-replica rolling means (uniform worker shares make
+        # this close enough for a 20-episode telemetry window)
+        return {
+            k: sum(s[k] for s in stats) / len(stats) for k in stats[0]
+        }
+
+    def transport_stats(self) -> dict[str, float]:
+        servers = self.servers()
+        per = [s.transport_stats() for s in servers]  # one scan per replica
+        # aggregate the raw byte/step counters, not the per-replica
+        # ratios (a ratio-of-means, like the single server computes for
+        # itself); intra-package access to the counters by design
+        wire = sum(s._wire_bytes for s in servers)
+        steps = sum(s._served_steps for s in servers)
+        out = {
+            "shm_workers": sum(t["shm_workers"] for t in per),
+            "pickle_workers": sum(t["pickle_workers"] for t in per),
+            "wire_bytes_per_step": wire / max(steps, 1),
+        }
+        occ = [
+            t["pipeline_occupancy"] for t in per if "pipeline_occupancy" in t
+        ]
+        if occ:
+            out["pipeline_occupancy"] = sum(occ) / len(occ)
+        return out
+
+    def queue_stats(self) -> dict[str, float]:
+        """Aggregated ``server/*`` gauges (sums for counters, means for
+        EWMAs) + the ``fleet/*`` tier gauges."""
+        servers = self.servers()
+        out: dict[str, float] = {
+            "server/queue_depth": float(self.chunks.qsize()),
+            "server/evicted_chunks": float(
+                sum(s.evicted_chunks for s in servers)
+            ),
+            "server/evicted_steps": float(
+                sum(s.evicted_steps for s in servers)
+            ),
+            "server/sanitized_requests": float(
+                sum(s.sanitized_requests for s in servers)
+            ),
+        }
+        serve = self._serve_ms_mean()
+        if serve is not None:
+            out["server/serve_ms"] = float(serve)
+        widths = [
+            s._serve_batch_ewma for s in servers
+            if s._serve_batch_ewma is not None
+        ]
+        if widths:
+            out["server/serve_batch"] = float(sum(widths) / len(widths))
+        out.update(
+            {f"server/{k}": v for k, v in self.transport_stats().items()}
+        )
+        lat = [
+            s.queue_stats().get("server/act_latency_ms") for s in servers
+        ]
+        lat = [v for v in lat if v is not None]
+        if lat:
+            out["server/act_latency_ms"] = float(sum(lat) / len(lat))
+        out.update(self.fleet_gauges())
+        return out
+
+    def fleet_gauges(self) -> dict[str, float]:
+        """The ``fleet/*`` gauge family (GAUGE_REGISTRY documents each)."""
+        out = {
+            "fleet/replicas_live": float(len(self._alive_slots())),
+            "fleet/respawns": float(self.respawns),
+            "fleet/scale_ups": float(self.scale_ups),
+            "fleet/scale_downs": float(self.scale_downs),
+            "fleet/queue_depth": float(self.chunks.qsize()),
+        }
+        serve = self._serve_ms_mean()
+        if serve is not None:
+            out["fleet/serve_ms"] = float(serve)
+        return out
+
+    def hop_stats(self) -> dict[str, dict]:
+        """Fleet-wide per-hop percentiles: the replicas' rolling sample
+        windows merged, so the ``hops`` telemetry event (and the serve
+        p50/p99 the bench records) covers the whole tier."""
+        from surreal_tpu.session.telemetry import latency_percentiles
+
+        transit: list[float] = []
+        serve: list[float] = []
+        for s in self.servers():
+            transit.extend(s._hop_transit)
+            serve.extend(s._hop_serve)
+        out = {}
+        p = latency_percentiles(transit)
+        if p is not None:
+            out["worker_to_server_ms"] = p
+        p = latency_percentiles(serve)
+        if p is not None:
+            out["serve_batch_ms"] = p
+        return out
+
+    def worker_traces(self) -> dict[str, str | None]:
+        out: dict[str, str | None] = {}
+        for s in self.servers():
+            out.update(s.worker_traces())
+        return out
+
+    def tier_event(self) -> dict:
+        """The ``serving_tier`` telemetry event body (diag's "Serving
+        tier" section): per-replica serve/budget/worker detail plus the
+        tier gauges."""
+        per_replica = {}
+        for i, srv in enumerate(self._replicas):
+            if srv is None:
+                per_replica[str(i)] = {"state": "drained"}
+                continue
+            per_replica[str(i)] = {
+                "state": "alive" if srv.alive else "dead",
+                "address": self._addresses[i],
+                "min_batch": srv.min_batch,
+                "serve_ms": srv._serve_ms_ewma,
+                "workers": len(srv.worker_traces()),
+                # the chunk queue is fleet-shared (fleet/queue_depth);
+                # evictions stay per-replica (who hit the full queue)
+                "evicted_chunks": srv.evicted_chunks,
+            }
+        return {
+            "replicas": per_replica,
+            "autoscale": self.autoscale,
+            "num_workers": self.num_workers,
+            **self.fleet_gauges(),
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            replicas, self._replicas = self._replicas, []
+        for srv in replicas:
+            if srv is not None:
+                srv.close()

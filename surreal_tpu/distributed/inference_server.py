@@ -102,14 +102,25 @@ class InferenceServer:
         auto_tune: bool = False,
         sanitize_obs: bool = True,
         trace_id: str | None = None,
+        version: int = 0,
+        chunks: "queue.Queue[dict] | None" = None,
     ):
+        # version: starting params version. The fleet supervisor
+        # (distributed/fleet.py) respawns a crashed replica with the
+        # fleet's CURRENT version so transitions it tags don't read as
+        # acted by an ancient policy (staleness = server.version -
+        # chunk.param_version — a reset-to-0 respawn would mass-drop).
+        # chunks: an externally-owned output queue — the fleet hands all
+        # replicas ONE queue so the trainer's chunk wait stays a native
+        # blocking get (and eviction prefers the oldest chunk
+        # FLEET-WIDE); None = own queue, the single-server default.
         # the run-scoped trace id this server belongs to (SessionHooks
         # mints it; the SEED trainer forwards it) — lets worker_traces()
         # consumers cross-check a frame's fleet against THIS run
         self.trace_id = trace_id
         self._act_fn = act_fn
         self._act_lock = threading.Lock()
-        self._version = 0  # params version; bumped by every set_act_fn
+        self._version = int(version)  # params version; bumped by set_act_fn
         self.unroll_length = unroll_length
         self.min_batch = min_batch
         self.max_wait_ms = max_wait_ms
@@ -126,7 +137,9 @@ class InferenceServer:
         # trusted planes.
         self.sanitize_obs = bool(sanitize_obs)
         self.sanitized_requests = 0
-        self.chunks: "queue.Queue[dict]" = queue.Queue(maxsize=64)
+        self.chunks: "queue.Queue[dict]" = (
+            chunks if chunks is not None else queue.Queue(maxsize=64)
+        )
         # data-plane observability (SURVEY.md §5.5: the reference's
         # tensorplex tracked replay/fetch-queue occupancy): queue-full
         # evictions cost real env steps — count chunks AND steps so the
@@ -194,13 +207,39 @@ class InferenceServer:
         with self._act_lock:
             return self._version
 
+    def address_for(self, worker_id: int) -> str:
+        """Uniform routing surface with :class:`~surreal_tpu.distributed.
+        fleet.InferenceFleet`: a single server routes every worker to
+        itself; the fleet hashes workers to replicas."""
+        return self.address
+
     # -- internals -----------------------------------------------------------
     def _loop(self) -> None:
+        # the finally matters for the FLEET lifecycle: a replica whose
+        # serve thread dies from an exception (incl. the kill_replica
+        # chaos injection) must release its bound ROUTER socket, or the
+        # supervisor's in-place respawn could never rebind the address
+        try:
+            self._loop_body()
+        finally:
+            self._sock.close(0)
+
+    def _loop_body(self) -> None:
         poller = zmq.Poller()
         poller.register(self._sock, zmq.POLLIN)
         pending: list[tuple[bytes, dict]] = []
         deadline: float | None = None
         while not self._stop.is_set():
+            f = faults.fire("fleet.replica")
+            if f is not None:
+                if f["kind"] == "kill_replica":
+                    # die like a real crash: the serve thread unwinds
+                    # (the _loop finally releases the socket), workers
+                    # time out and re-hello to fleet survivors, and the
+                    # fleet supervisor respawns this replica in place
+                    raise faults.FaultInjected("chaos: kill_replica")
+                if f["kind"] == "delay":
+                    faults.sleep_ms(f)
             timeout = 5.0
             if pending and deadline is not None:
                 timeout = max(0.0, (deadline - time.monotonic()) * 1000)
@@ -243,7 +282,6 @@ class InferenceServer:
                 self._serve_batch(pending)
                 pending = []
                 deadline = None
-        self._sock.close(0)
 
     def _retune(self) -> None:
         """Coalescing auto-tune: one forward per lockstep fleet round.
@@ -638,6 +676,13 @@ class InferenceServer:
         self._thread.join()
         for st in self._states.values():
             self._release_slab(st)
+
+    @property
+    def alive(self) -> bool:
+        """Serve thread liveness — the fleet supervisor's death signal
+        (a crashed loop has already released its socket; close() still
+        releases the slabs)."""
+        return self._thread.is_alive()
 
     def close(self) -> None:
         self._stop.set()

@@ -1,0 +1,435 @@
+"""Versioned parameter fanout (ISSUE 10 tentpole, piece 2): the learner
+publishes weight FRAMES over a pub/sub tree instead of answering N
+point-to-point ``ParameterClient.fetch`` pickles — publish bytes scale
+with ONE encode + N subscribes (the reference's PS fan-out problem,
+SURVEY.md §2.1, solved by broadcast instead of sharding).
+
+Frame arms (``session.publish.fanout``):
+
+- **full f32** — the baseline: every leaf's raw bytes in canonical
+  (template flatten) order. Exact.
+- **bf16 wire** (``wire='bf16'``) — floating leaves cast to bfloat16 on
+  the wire, f32 reconstruct on receive (the ``'bf16'`` policy dtype of
+  ``ops/precision.py``). Halves float bytes; reconstruction is EXACTLY
+  the bf16-rounded value (deterministic cast), within bf16's relative
+  tolerance (2^-8 mantissa) of the true params.
+- **delta** (``delta=True``) — frames encode ``params - shadow`` against
+  the subscriber's acked version, zlib-compressed (adjacent SGD steps
+  move little; near-zero deltas compress hugely). The publisher keeps a
+  SHADOW — the pytree subscribers reconstruct by applying its own frames
+  — and always deltas against that, so wire-dtype quantization error
+  never accumulates: publisher shadow and subscriber params stay
+  bit-identical, both within one rounding step of the true params.
+
+Delivery/fallback contract (the ``ParameterClient.fetch`` path STAYS):
+
+- Subscribers ack the version they applied (PUSH -> the publisher's
+  PULL). A publish only deltas when every fresh ack sits at the current
+  shadow version; any stale ack (a dropped frame, a new subscriber)
+  re-keys the stream with a FULL frame — delta against a stale acked
+  version falls back to a full frame, publisher-side.
+- A subscriber that receives a delta whose base is not its version
+  (it missed a frame before the publisher learned) drops it, counts it
+  (``stale_frames``), and raises ``needs_resync`` — the owner catches up
+  through :meth:`ParameterSubscriber.catch_up` (a plain
+  ``ParameterClient.fetch`` against the session's ParameterServer, the
+  late-joiner path) and the stream resumes. Counted, never silent.
+
+Chaos site ``param.publish``: ``delay_publish`` stalls the broadcast;
+``drop_frame`` swallows it on the wire (the re-key path above recovers).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+import uuid
+import zlib
+from typing import Any, Sequence
+
+import numpy as np
+
+from surreal_tpu.utils import faults
+
+# bfloat16 as a numpy dtype — jax's ml_dtypes registration, the same
+# dtype the 'bf16' precision policy computes in (ops/precision.py)
+import jax.numpy as jnp
+
+BF16 = np.dtype(jnp.bfloat16)
+
+MAGIC = b"\xa5PF1"
+_FRAME_HDR = struct.Struct("<QQB")  # version, base_version, flags
+F_DELTA = 1
+F_BF16 = 2
+F_ZLIB = 4
+
+TOPIC = b"frame"
+
+
+def _flatten(tree: Any) -> list:
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def _unflatten(template: Any, leaves: Sequence) -> Any:
+    import jax
+
+    return jax.tree.unflatten(jax.tree.structure(template), list(leaves))
+
+
+class FanoutCodec:
+    """Frame encode/decode over one pytree structure. Both ends flatten
+    with ``jax.tree`` (the same canonical order ``ParameterClient``'s
+    template contract relies on). Floating leaves ride the wire dtype;
+    integer/bool leaves always ship raw and FULL (a count's delta buys
+    nothing and would break exactness)."""
+
+    def __init__(self, template: Any):
+        leaves = _flatten(template)
+        self.template = template
+        self.dtypes = [np.asarray(l).dtype for l in leaves]
+        self.shapes = [np.shape(l) for l in leaves]
+        self.floating = [np.issubdtype(d, np.floating) for d in self.dtypes]
+
+    def _wire_dtype(self, i: int, wire: str) -> np.dtype:
+        if wire == "bf16" and self.floating[i]:
+            return BF16
+        return self.dtypes[i]
+
+    def encode(
+        self,
+        version: int,
+        leaves: Sequence[np.ndarray],
+        *,
+        wire: str = "f32",
+        base_version: int = 0,
+        shadow: Sequence[np.ndarray] | None = None,
+    ) -> tuple[bytes, list[np.ndarray]]:
+        """One frame + the post-frame shadow (what a subscriber that
+        applies this frame now holds — f32). ``shadow`` present = delta
+        frame against it; absent = full frame."""
+        flags = 0
+        if wire == "bf16":
+            flags |= F_BF16
+        parts = []
+        new_shadow: list[np.ndarray] = []
+        for i, leaf in enumerate(leaves):
+            arr = np.asarray(leaf)
+            if not self.floating[i]:
+                parts.append(np.ascontiguousarray(arr, self.dtypes[i]).tobytes())
+                new_shadow.append(np.array(arr, self.dtypes[i]))
+                continue
+            f32 = np.asarray(arr, np.float32)
+            wdt = self._wire_dtype(i, wire)
+            if shadow is not None:
+                delta = (f32 - shadow[i]).astype(wdt)
+                parts.append(np.ascontiguousarray(delta).tobytes())
+                new_shadow.append(shadow[i] + delta.astype(np.float32))
+            else:
+                cast = f32.astype(wdt)
+                parts.append(np.ascontiguousarray(cast).tobytes())
+                new_shadow.append(cast.astype(np.float32))
+        body = b"".join(parts)
+        if shadow is not None:
+            flags |= F_DELTA | F_ZLIB
+            body = zlib.compress(body, 1)
+        frame = (
+            MAGIC
+            + _FRAME_HDR.pack(int(version), int(base_version), flags)
+            + body
+        )
+        return frame, new_shadow
+
+    def decode(
+        self, frame: bytes, current: Sequence[np.ndarray] | None
+    ) -> tuple[int, int, list[np.ndarray] | None]:
+        """-> (version, base_version, leaves-or-None). None leaves =
+        an inapplicable delta (base != the caller's state)."""
+        if frame[:4] != MAGIC:
+            raise ValueError("not a parameter fanout frame")
+        version, base_version, flags = _FRAME_HDR.unpack_from(frame, 4)
+        body = frame[4 + _FRAME_HDR.size:]
+        is_delta = bool(flags & F_DELTA)
+        if is_delta and current is None:
+            return version, base_version, None
+        if flags & F_ZLIB:
+            body = zlib.decompress(body)
+        wire = "bf16" if flags & F_BF16 else "f32"
+        leaves: list[np.ndarray] = []
+        off = 0
+        for i, shape in enumerate(self.shapes):
+            wdt = (
+                self._wire_dtype(i, wire) if self.floating[i]
+                else self.dtypes[i]
+            )
+            n = int(np.prod(shape, dtype=np.int64))
+            arr = np.frombuffer(
+                body, wdt, count=n, offset=off
+            ).reshape(shape)
+            off += n * wdt.itemsize
+            if not self.floating[i]:
+                leaves.append(np.array(arr))
+            elif is_delta:
+                leaves.append(current[i] + arr.astype(np.float32))
+            else:
+                leaves.append(arr.astype(np.float32))
+        return version, base_version, leaves
+
+
+class ParameterFanout:
+    """Learner-side broadcast: PUB for frames, PULL for subscriber acks.
+    One ``publish`` per cadence fire; the full-vs-delta decision reads
+    the freshest acks (see the module doc's fallback contract)."""
+
+    def __init__(
+        self,
+        bind: str = "tcp://127.0.0.1:*",
+        ack_bind: str = "tcp://127.0.0.1:*",
+        wire: str = "f32",
+        delta: bool = True,
+        ack_ttl_s: float = 60.0,
+    ):
+        if wire not in ("f32", "bf16"):
+            raise ValueError(f"fanout wire {wire!r} not in f32|bf16")
+        import zmq
+
+        self._ctx = zmq.Context.instance()
+        self._pub = self._ctx.socket(zmq.PUB)
+        self._pub.bind(bind)
+        self.address = self._pub.getsockopt_string(zmq.LAST_ENDPOINT)
+        self._ack = self._ctx.socket(zmq.PULL)
+        self._ack.bind(ack_bind)
+        self.ack_address = self._ack.getsockopt_string(zmq.LAST_ENDPOINT)
+        self.wire = wire
+        self.delta = bool(delta)
+        self.ack_ttl_s = float(ack_ttl_s)
+        self.version = 0
+        self._codec: FanoutCodec | None = None
+        self._shadow: list[np.ndarray] | None = None
+        self._shadow_version = 0
+        self._acked: dict[str, tuple[int, float]] = {}  # id -> (ver, t)
+        self.frames = 0
+        self.full_frames = 0
+        self.delta_frames = 0
+        self.rekeys = 0  # full frames FORCED by a stale/absent ack
+        self.bytes_published = 0
+        self.last_bytes = 0
+
+    def _drain_acks(self) -> None:
+        import zmq
+
+        while True:
+            try:
+                msg = self._ack.recv(zmq.NOBLOCK)
+            except zmq.ZMQError:
+                return
+            try:
+                ack = json.loads(msg.decode())
+                self._acked[str(ack["id"])] = (
+                    int(ack["version"]), time.monotonic(),
+                )
+            except (ValueError, KeyError):
+                continue  # malformed ack: a subscriber bug, not ours
+
+    def _fresh_acks(self) -> list[int]:
+        now = time.monotonic()
+        return [
+            v for v, t in self._acked.values()
+            if now - t <= self.ack_ttl_s
+        ]
+
+    @property
+    def subscribers(self) -> int:
+        return len(self._fresh_acks())
+
+    def publish(self, params: Any) -> dict:
+        """Broadcast one version; returns {version, bytes, kind}."""
+        import jax
+
+        if self._codec is None:
+            self._codec = FanoutCodec(params)
+        self._drain_acks()
+        self.version += 1
+        leaves = [np.asarray(l) for l in jax.device_get(_flatten(params))]
+        acks = self._fresh_acks()
+        want_delta = (
+            self.delta
+            and self._shadow is not None
+            and self._shadow_version == self.version - 1
+        )
+        if want_delta and (not acks or min(acks) < self.version - 1):
+            # delta against a version some subscriber never acked falls
+            # back to a FULL frame (re-key): a late joiner / dropped
+            # frame must not strand the stream on fetch fallbacks
+            want_delta = False
+            self.rekeys += 1
+        if want_delta:
+            frame, shadow = self._codec.encode(
+                self.version, leaves, wire=self.wire,
+                base_version=self._shadow_version, shadow=self._shadow,
+            )
+            kind = "delta"
+            self.delta_frames += 1
+        else:
+            frame, shadow = self._codec.encode(
+                self.version, leaves, wire=self.wire,
+            )
+            kind = "full"
+            self.full_frames += 1
+        self._shadow = shadow
+        self._shadow_version = self.version
+        self.frames += 1
+        self.last_bytes = len(frame)
+        self.bytes_published += len(frame)
+        f = faults.fire("param.publish")
+        if f is not None:
+            if f["kind"] == "delay_publish":
+                faults.sleep_ms(f)
+            elif f["kind"] == "drop_frame":
+                # swallowed on the wire: subscribers miss this version,
+                # their acks go stale, and the next publish re-keys FULL
+                return {"version": self.version, "bytes": len(frame),
+                        "kind": kind, "dropped": True}
+        self._pub.send_multipart([TOPIC, frame])
+        return {"version": self.version, "bytes": len(frame), "kind": kind}
+
+    def gauges(self) -> dict[str, float]:
+        """The ``param/*`` gauge family (GAUGE_REGISTRY documents each)."""
+        return {
+            "param/publishes": float(self.frames),
+            "param/full_frames": float(self.full_frames),
+            "param/delta_frames": float(self.delta_frames),
+            "param/rekeys": float(self.rekeys),
+            "param/bytes_last_publish": float(self.last_bytes),
+            "param/bytes_published": float(self.bytes_published),
+            "param/subscribers": float(self.subscribers),
+        }
+
+    def close(self) -> None:
+        self._pub.close(0)
+        self._ack.close(0)
+
+
+class ParameterSubscriber:
+    """Replica/actor-side: SUB for frames, PUSH for acks. Owns the
+    reconstructed f32 pytree + version; inapplicable deltas raise
+    ``needs_resync`` and :meth:`catch_up` closes the gap through the
+    fetch fallback (the late-joiner path)."""
+
+    def __init__(self, address: str, ack_address: str, template: Any,
+                 ident: str | None = None):
+        import zmq
+
+        self._ctx = zmq.Context.instance()
+        self._sub = self._ctx.socket(zmq.SUB)
+        self._sub.connect(address)
+        self._sub.setsockopt(zmq.SUBSCRIBE, TOPIC)
+        self._push = self._ctx.socket(zmq.PUSH)
+        self._push.setsockopt(zmq.SNDTIMEO, 1000)
+        self._push.connect(ack_address)
+        self.ident = ident or uuid.uuid4().hex[:12]
+        self.codec = FanoutCodec(template)
+        self.template = template
+        self._leaves: list[np.ndarray] | None = None
+        self.version = 0
+        self.applied = 0
+        self.stale_frames = 0
+        self.fallback_fetches = 0
+        self.needs_resync = False
+
+    @property
+    def params(self) -> Any | None:
+        if self._leaves is None:
+            return None
+        return _unflatten(self.template, self._leaves)
+
+    def _send_ack(self) -> None:
+        import zmq
+
+        try:
+            self._push.send(
+                json.dumps({"id": self.ident, "version": self.version}).encode(),
+                zmq.NOBLOCK,
+            )
+        except zmq.ZMQError:
+            pass  # acks are advisory; the publisher's ttl handles silence
+
+    def poll(self, timeout_ms: int = 0) -> Any | None:
+        """Apply every waiting frame in order; returns the new params
+        pytree when the version advanced, else None. An inapplicable
+        delta (missed frame / fresh subscriber) sets ``needs_resync``
+        and is counted — the owner should :meth:`catch_up`."""
+        import zmq
+
+        advanced = False
+        deadline = time.monotonic() + timeout_ms / 1e3
+        while True:
+            try:
+                _, frame = self._sub.recv_multipart(zmq.NOBLOCK)
+            except zmq.ZMQError:
+                if advanced or time.monotonic() >= deadline:
+                    break
+                self._sub.poll(max(1, int(timeout_ms / 4)))
+                continue
+            version, base, leaves = self.codec.decode(frame, self._leaves)
+            if leaves is None or (base and base != self.version):
+                # a delta we cannot apply: count + flag, never guess
+                self.stale_frames += 1
+                self.needs_resync = True
+                continue
+            self._leaves = leaves
+            self.version = version
+            self.applied += 1
+            self.needs_resync = False
+            advanced = True
+        if advanced:
+            self._send_ack()
+            return self.params
+        return None
+
+    def resync(self, params: Any, version: int) -> None:
+        """Install a fetched snapshot (late joiner / post-gap catch-up)
+        and re-enter the delta stream from its version."""
+        self._leaves = [
+            np.asarray(l, np.float32)
+            if np.issubdtype(np.asarray(l).dtype, np.floating)
+            else np.asarray(l)
+            for l in _flatten(params)
+        ]
+        self.version = int(version)
+        self.needs_resync = False
+        self._send_ack()
+
+    def catch_up(self, client) -> bool:
+        """Close a gap through the fetch fallback: one
+        ``ParameterClient.fetch`` (version-conditional — 'unchanged'
+        costs ~14 bytes) against the session's ParameterServer, counted
+        as a fallback. Returns True when a snapshot was installed."""
+        self.fallback_fetches += 1
+        got = client.fetch()
+        if got is None:
+            # 'unchanged': the server sits at the CLIENT's version. Only
+            # a subscriber that actually HOLDS params may claim that
+            # position (refresh the ack so the publisher re-keys off our
+            # true spot) — a fresh subscriber with no snapshot must not
+            # ack a stream position it cannot apply deltas from.
+            if client.version and self._leaves is not None:
+                self.version = int(client.version)
+                self.needs_resync = False
+                self._send_ack()
+            return False
+        self.resync(got, client.version)
+        return True
+
+    def gauges(self) -> dict[str, float]:
+        return {
+            "param/applied_frames": float(self.applied),
+            "param/stale_frames": float(self.stale_frames),
+            "param/fallback_fetches": float(self.fallback_fetches),
+        }
+
+    def close(self) -> None:
+        self._sub.close(0)
+        self._push.close(0)

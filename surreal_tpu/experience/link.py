@@ -1,0 +1,178 @@
+"""Shared shard-link plumbing for the experience plane (the follow-up
+declined at the end of PR 8): one DEALER-per-shard link base and ONE
+hello-negotiation routine, used by both `experience/sender.py` and
+`experience/sampler.py` — the two previously carried ~100 duplicated
+lines of token handshake / slab attach / backoff bookkeeping that had to
+be fixed twice (and once wasn't).
+
+The negotiation contract (unchanged from PR 8):
+
+- every hello carries a per-attempt token the reply must echo — a stale
+  grant from an earlier timed-out attempt is dropped, never attached
+  (the shard unlinks superseded grants on its side);
+- a granted shm slab is attached client-side (client-OWNED cleanup, the
+  wire.create_slab rule); an attach failure degrades the link to the raw
+  tcp codec, never to dead;
+- a renegotiation that replaced the segment unlinks the orphan NOW (a
+  SIGKILLed shard cannot do it);
+- success resets the link's dead/backoff state.
+
+Role differences stay with the owners: the sender re-bases watermarks on
+``ingested_rows`` and counts invalidated inflight frames; the sampler
+derives its slot count. They hook in via :meth:`ShardLinkBase.on_slab`
+and the returned reply dict.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from surreal_tpu.experience import wire
+
+
+class ShardLinkBase:
+    """One DEALER connection to one shard server: socket identity,
+    negotiated transport/slab state, and the dead/backoff bookkeeping
+    shared by sender and sampler links."""
+
+    def __init__(self, address: str, shard_id: int, identity: str):
+        import zmq
+
+        self.address = address
+        self.shard_id = shard_id
+        self.sock = zmq.Context.instance().socket(zmq.DEALER)
+        self.sock.setsockopt(zmq.IDENTITY, identity.encode())
+        self.sock.setsockopt(zmq.SNDTIMEO, 10_000)
+        self.sock.connect(address)
+        self.transport = "pickle"
+        self.negotiated = False
+        self.spec: wire.PlaneSpec | None = None
+        self.slab = None
+        self.views: list[dict] = []
+        self.seq = 0
+        self.dead = False
+        self.failures = 0
+        self.next_attempt = 0.0
+
+    def on_slab(self, layout: wire.PlaneSlab) -> None:
+        """Role hook: called with the granted slab layout after a
+        successful shm attach (sender: seed the free-slot list; sampler:
+        record the slot count)."""
+
+    def schedule_backoff(self, base: float, cap: float) -> bool:
+        """Mark dead + arm the next revival attempt (base * 2^k capped —
+        the SEED respawn schedule). Returns False so callers can
+        ``return link.schedule_backoff(...)`` from their _mark_dead."""
+        self.dead = True
+        self.failures += 1
+        self.next_attempt = time.monotonic() + min(
+            cap, base * 2.0 ** (self.failures - 1)
+        )
+        return False
+
+    def revive_due(self) -> bool:
+        """True when a dead link's backoff window has elapsed (an alive,
+        negotiated link needs no revival)."""
+        return not self.dead or time.monotonic() >= self.next_attempt
+
+    def close(self) -> None:
+        # CLIENT-owned slab cleanup (wire.create_slab's rule): unlink the
+        # shard-created segment we attached to
+        self.views = []
+        wire.unlink_slab(self.slab)
+        self.slab = None
+        self.sock.close(100)
+
+
+def negotiate_link(
+    link: ShardLinkBase,
+    send: Callable[[bytes], None],
+    *,
+    role: str,
+    spec: wire.PlaneSpec | None,
+    slot_rows: int,
+    slots: int,
+    mode: str,
+    timeout_s: float,
+    trace: str | None,
+    stop_event=None,
+    seq_base: int | None = None,
+    force_tcp: bool = False,
+) -> dict | None:
+    """Run the hello handshake on one link.
+
+    ``send`` ships the payload on ``link.sock`` (the sender passes its
+    fault-site/byte-counting ``_send_raw``; it may raise ``zmq.ZMQError``).
+    ``seq_base`` rides the hello when given (the sender's dedup re-base);
+    ``force_tcp`` downgrades a resolved shm want (the FIFO sampler, whose
+    chunk layouts are only known in-frame). Returns the shard's reply
+    dict on success — transport resolved, slab attached/replaced, link
+    dead/backoff state reset — or None (the caller marks the link dead
+    under its own backoff/accounting rules)."""
+    import secrets
+
+    import zmq
+
+    token = secrets.token_hex(4)
+    want = wire.resolve_transport(mode, link.address)
+    if force_tcp and want == "shm":
+        want = "tcp"
+    if want == "pickle":
+        msg = {
+            "kind": "hello", "role": role,
+            "spec": spec.to_json() if spec else None,
+            "slot_rows": int(slot_rows), "slots": int(slots),
+            "transport": "pickle", "trace": trace, "token": token,
+        }
+        if seq_base is not None:
+            msg["seq_base"] = int(seq_base)
+        payload = wire.encode_pickle_msg(msg)
+    else:
+        payload = wire.encode_hello(
+            role, spec, slot_rows, slots, want,
+            trace=trace, token=token, seq_base=seq_base or 0,
+        )
+    try:
+        send(payload)
+    except zmq.ZMQError:
+        return None
+    deadline = time.monotonic() + timeout_s
+    kind, obj = None, None
+    while time.monotonic() < deadline:
+        if stop_event is not None and stop_event.is_set():
+            return None
+        if not link.sock.poll(100):
+            continue
+        kind, obj = wire.decode_payload(link.sock.recv())
+        if kind == "msg":
+            kind = obj.get("kind", "?")
+        if kind in ("hello_ok", "hello_no") and obj.get("token") == token:
+            break
+        # stray acks / stale grants from earlier attempts: drop and keep
+        # waiting (the shard unlinked any superseded slab)
+        kind = None
+    if kind != "hello_ok":
+        return None  # timeout, stop, or an explicit hello_no
+    granted = obj.get("transport", "tcp")
+    old_slab = link.slab
+    link.slab, link.views = None, []
+    if granted == "shm":
+        try:
+            layout = wire.PlaneSlab.from_json(obj["slab"])
+            link.slab = wire.attach_slab(obj["name"])
+            link.views = layout.views(link.slab.buf)
+            link.on_slab(layout)
+        except (OSError, ValueError, KeyError):
+            granted = "tcp"  # degraded, never dead: raw codec always works
+    link.transport = granted
+    if old_slab is not None and (
+        link.slab is None or old_slab.name != link.slab.name
+    ):
+        # renegotiation replaced the segment: unlink the orphan NOW
+        # (client-owned cleanup — a SIGKILLed shard can't do it)
+        wire.unlink_slab(old_slab)
+    link.negotiated = True
+    link.dead = False
+    link.failures = 0
+    return obj

@@ -26,17 +26,7 @@ from surreal_tpu.experience.sampler import ShardedSampler
 from surreal_tpu.experience.sender import ExperienceSender
 from surreal_tpu.experience.shard import run_shard_server
 from surreal_tpu.utils import faults
-
-
-def _alloc_address() -> str:
-    """Pick a free loopback port (bind-then-close; the same small TOCTOU
-    window the --local-procs coordinator accepts — a lost race surfaces
-    as a shard bind failure and a supervised respawn)."""
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return f"tcp://127.0.0.1:{s.getsockname()[1]}"
+from surreal_tpu.utils.net import alloc_address as _alloc_address
 
 
 class ExperiencePlane:
@@ -101,10 +91,14 @@ class ExperiencePlane:
         self._fault_plan_sent: set[int] = set()
         self.respawns = 0
         self.respawn_backoff_s = 0.0
-        now = time.monotonic()
-        self._failures = [0] * S
-        self._next_spawn_at = [0.0] * S
-        self._spawned_at = [now] * S
+        # the shared respawn state machine (utils/respawn.py): immediate
+        # first respawn, base * 2^k capped, healthy-streak reset
+        from surreal_tpu.utils.respawn import RespawnSchedule
+
+        self._sched = RespawnSchedule(
+            S, self._backoff_base, self._backoff_cap,
+            healthy_s=self._HEALTHY_S,
+        )
         self._supervise_lock = threading.Lock()
         self.shards = [self._spawn_shard(i) for i in range(S)]
 
@@ -196,24 +190,13 @@ class ExperiencePlane:
             now = time.monotonic()
             for i, w in enumerate(self.shards):
                 if w.is_alive():
-                    if (
-                        self._failures[i]
-                        and now - self._spawned_at[i] > self._HEALTHY_S
-                    ):
-                        self._failures[i] = 0
+                    self._sched.note_alive(i, now)
                     continue
-                if now < self._next_spawn_at[i]:
+                if not self._sched.due(i, now):
                     continue  # backing off a crash-looping shard
                 self.shards[i] = self._spawn_shard(i)
                 self.respawns += 1
-                self._failures[i] += 1
-                self._spawned_at[i] = now
-                backoff = min(
-                    self._backoff_cap,
-                    self._backoff_base * 2.0 ** (self._failures[i] - 1),
-                )
-                self._next_spawn_at[i] = now + backoff
-                self.respawn_backoff_s = backoff
+                self.respawn_backoff_s = self._sched.respawned(i, now)
 
     # -- gauges / telemetry --------------------------------------------------
     def _poll_stats(self, timeout_ms: int = 200) -> None:

@@ -36,30 +36,21 @@ from typing import Any, Sequence
 import numpy as np
 
 from surreal_tpu.experience import wire
+from surreal_tpu.experience.link import ShardLinkBase, negotiate_link
 
 
-class _SampleLink:
+class _SampleLink(ShardLinkBase):
+    """Sampler-side shard link: the shared base plus the reply-slot
+    cursor and the lazy main-thread priority/stats channel."""
+
     def __init__(self, address: str, shard_id: int, identity: str):
-        import zmq
-
-        self.address = address
-        self.shard_id = shard_id
-        ctx = zmq.Context.instance()
-        self.sock = ctx.socket(zmq.DEALER)
-        self.sock.setsockopt(zmq.IDENTITY, identity.encode())
-        self.sock.setsockopt(zmq.SNDTIMEO, 10_000)
-        self.sock.connect(address)
+        super().__init__(address, shard_id, identity)
         self.prio_sock = None  # lazy: main-thread priority/stats channel
-        self.transport = "pickle"
-        self.negotiated = False
-        self.slab = None
-        self.views: list[dict] = []
         self.slots = 1
         self.next_slot = 0
-        self.seq = 0
-        self.dead = False
-        self.failures = 0
-        self.next_attempt = 0.0
+
+    def on_slab(self, layout: wire.PlaneSlab) -> None:
+        self.slots = layout.slots
 
     def prio_channel(self):
         import zmq
@@ -71,10 +62,7 @@ class _SampleLink:
         return self.prio_sock
 
     def close(self) -> None:
-        self.views = []
-        wire.unlink_slab(self.slab)  # client-owned cleanup
-        self.slab = None
-        self.sock.close(100)
+        super().close()  # client-owned slab cleanup + sample socket
         if self.prio_sock is not None:
             self.prio_sock.close(100)
 
@@ -145,88 +133,34 @@ class ShardedSampler:
 
     # -- negotiation (sample channel; prefetch thread) -----------------------
     def _negotiate(self, link: _SampleLink, timeout_s: float) -> bool:
-        want = wire.resolve_transport(self.mode, link.address)
-        if self.kind == "fifo" and want == "shm":
-            # chunk layouts are only known to the shard after its first
-            # insert — the FIFO arm's replies carry their spec in-frame
-            # over the raw codec instead of a pre-negotiated slab
-            want = "tcp"
-        # 2x updates_per_iter sample slots: the burst fan-out keeps K
-        # outstanding, and a retried straggler must land in a slot no
-        # in-flight duplicate serve can still write
-        slots = 2 * self.updates_per_iter
-        import secrets
-
-        token = secrets.token_hex(4)
-        if want == "pickle":
-            payload = wire.encode_pickle_msg({
-                "kind": "hello", "role": "sampler",
-                "spec": self.spec.to_json() if self.spec else None,
-                "slot_rows": self.bs_shard, "slots": slots,
-                "transport": "pickle", "trace": self.trace, "token": token,
-            })
-        else:
-            payload = wire.encode_hello(
-                "sampler", self.spec, self.bs_shard, slots,
-                want, trace=self.trace, token=token,
-            )
-        import zmq
-
-        try:
+        """Hello handshake — the shared ``experience/link.py`` routine.
+        2x updates_per_iter sample slots: the burst fan-out keeps K
+        outstanding, and a retried straggler must land in a slot no
+        in-flight duplicate serve can still write. The FIFO arm forces
+        the raw tcp codec (chunk layouts are only known to the shard
+        after its first insert — replies carry their spec in-frame)."""
+        def send(payload: bytes) -> None:
             self.wire_bytes += len(payload)
             link.sock.send(payload)
-        except zmq.ZMQError:
+
+        obj = negotiate_link(
+            link, send,
+            role="sampler", spec=self.spec, slot_rows=self.bs_shard,
+            slots=2 * self.updates_per_iter, mode=self.mode,
+            timeout_s=timeout_s, trace=self.trace, stop_event=self._stop,
+            force_tcp=self.kind == "fifo",
+        )
+        if obj is None:
             return self._mark_dead(link)
-        deadline = time.monotonic() + timeout_s
-        kind = None
-        while time.monotonic() < deadline:
-            if self._stop is not None and self._stop.is_set():
-                return self._mark_dead(link)
-            if not link.sock.poll(100):
-                continue
-            kind, obj = wire.decode_payload(link.sock.recv())
-            if kind == "msg":
-                kind = obj.get("kind", "?")
-            if (
-                kind in ("hello_ok", "hello_no")
-                and obj.get("token") == token
-            ):
-                break
-            kind = None  # stale grant from an earlier attempt: drop
-        if kind != "hello_ok":
-            return self._mark_dead(link)
-        granted = obj.get("transport", "tcp")
-        old_slab = link.slab
-        link.slab, link.views = None, []
-        if granted == "shm":
-            try:
-                layout = wire.PlaneSlab.from_json(obj["slab"])
-                link.slab = wire.attach_slab(obj["name"])
-                link.views = layout.views(link.slab.buf)
-                link.slots = layout.slots
-            except (OSError, ValueError, KeyError):
-                granted = "tcp"
-        link.transport = granted
-        if old_slab is not None and (link.slab is None
-                                     or old_slab.name != link.slab.name):
-            wire.unlink_slab(old_slab)
-        link.negotiated = True
-        link.dead = False
-        link.failures = 0
         return True
 
     def _mark_dead(self, link: _SampleLink) -> bool:
-        link.dead = True
-        link.failures += 1
-        link.next_attempt = time.monotonic() + min(
-            self._respawn_cap, self._respawn_base * 2.0 ** (link.failures - 1)
-        )
-        return False
+        return link.schedule_backoff(self._respawn_base, self._respawn_cap)
 
     def _revive(self, link: _SampleLink) -> bool:
         if link.negotiated and not link.dead:
             return True
-        if link.dead and time.monotonic() < link.next_attempt:
+        if not link.revive_due():
             return False
         return self._negotiate(
             link, self.hello_timeout_s if not link.dead else 2.0

@@ -33,6 +33,7 @@ from typing import Mapping, Sequence
 import numpy as np
 
 from surreal_tpu.experience import wire
+from surreal_tpu.experience.link import ShardLinkBase, negotiate_link
 from surreal_tpu.utils import faults
 
 
@@ -46,41 +47,21 @@ def shard_of_slot(slot: int, num_shards: int) -> int:
     return zlib.crc32(int(slot).to_bytes(8, "little")) % num_shards
 
 
-class _ShardLink:
-    """One DEALER connection to one shard server."""
+class _ShardLink(ShardLinkBase):
+    """Sender-side shard link: the shared base plus the INSERT-window
+    state (slab free slots, unacked-frame inflight map, watermark)."""
 
     def __init__(self, address: str, shard_id: int, identity: str):
-        import zmq
-
-        self.address = address
-        self.shard_id = shard_id
-        self.sock = zmq.Context.instance().socket(zmq.DEALER)
-        self.sock.setsockopt(zmq.IDENTITY, identity.encode())
-        self.sock.setsockopt(zmq.SNDTIMEO, 10_000)
-        self.sock.connect(address)
-        self.transport = "pickle"
-        self.negotiated = False
-        self.spec: wire.PlaneSpec | None = None
-        self.slab = None
-        self.views: list[dict] = []
+        super().__init__(address, shard_id, identity)
         self.free_slots: list[int] = []
-        self.seq = 0
         # seq -> [slab slot or None, resendable frame bytes, n rows,
         #         monotonic send stamp (refreshed on resend)]
         self.inflight: dict[int, list] = {}
         self.sent_rows = 0
-        self.dead = False
-        self.failures = 0
-        self.next_attempt = 0.0
         self.stale_resends = 0    # consecutive no-ack resend rounds
 
-    def close(self) -> None:
-        # CLIENT-owned slab cleanup (wire.create_slab's rule): unlink the
-        # shard-created segment we attached to
-        self.views = []
-        wire.unlink_slab(self.slab)
-        self.slab = None
-        self.sock.close(100)
+    def on_slab(self, layout: wire.PlaneSlab) -> None:
+        self.free_slots = list(range(layout.slots))
 
 
 class ExperienceSender:
@@ -138,71 +119,19 @@ class ExperienceSender:
 
     # -- negotiation ---------------------------------------------------------
     def _negotiate(self, link: _ShardLink, timeout_s: float) -> bool:
-        """Run the hello handshake on one link; marks the link dead on
-        timeout (revived later under the backoff schedule). The hello
-        carries a per-attempt token the reply must echo — a stale grant
-        from an earlier timed-out attempt must be dropped, not attached
-        (the shard unlinks superseded grants on its side)."""
-        import secrets
-
-        import zmq
-
-        token = secrets.token_hex(4)
-        want = wire.resolve_transport(self.mode, link.address)
-        if want == "pickle":
-            payload = wire.encode_pickle_msg({
-                "kind": "hello", "role": "sender",
-                "spec": self.spec.to_json() if self.spec else None,
-                "slot_rows": self.slot_rows, "slots": self.insert_slots,
-                "transport": "pickle", "trace": self.trace, "token": token,
-                "seq_base": link.seq,
-            })
-        else:
-            payload = wire.encode_hello(
-                "sender", self.spec, self.slot_rows, self.insert_slots,
-                want, trace=self.trace, token=token, seq_base=link.seq,
-            )
-        try:
-            self._send_raw(link, payload)
-        except zmq.ZMQError:
+        """Hello handshake — the shared ``experience/link.py`` routine,
+        sent through ``_send_raw`` so the chaos site and byte accounting
+        cover hellos too — plus the sender-specific post-processing:
+        watermark re-base and inflight invalidation. Failure marks the
+        link dead (revived later under the backoff schedule)."""
+        obj = negotiate_link(
+            link, lambda payload: self._send_raw(link, payload),
+            role="sender", spec=self.spec, slot_rows=self.slot_rows,
+            slots=self.insert_slots, mode=self.mode, timeout_s=timeout_s,
+            trace=self.trace, stop_event=self._stop, seq_base=link.seq,
+        )
+        if obj is None:
             return self._mark_dead(link)
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self._stop is not None and self._stop.is_set():
-                return self._mark_dead(link)
-            if not link.sock.poll(100):
-                continue
-            kind, obj = wire.decode_payload(link.sock.recv())
-            if kind == "msg":
-                kind = obj.get("kind", "?")
-            if (
-                kind in ("hello_ok", "hello_no")
-                and obj.get("token") == token
-            ):
-                break
-            # stray acks / stale grants from earlier attempts: drop and
-            # keep waiting (the shard unlinked any superseded slab)
-        else:
-            return self._mark_dead(link)
-        if kind == "hello_no":
-            return self._mark_dead(link)
-        granted = obj.get("transport", "tcp")
-        old_slab = link.slab
-        link.slab, link.views = None, []
-        if granted == "shm":
-            try:
-                layout = wire.PlaneSlab.from_json(obj["slab"])
-                link.slab = wire.attach_slab(obj["name"])
-                link.views = layout.views(link.slab.buf)
-                link.free_slots = list(range(layout.slots))
-            except (OSError, ValueError, KeyError):
-                granted = "tcp"  # degraded, never dead: raw codec always works
-        link.transport = granted
-        if old_slab is not None and (link.slab is None
-                                     or old_slab.name != link.slab.name):
-            # renegotiation replaced the segment: unlink the orphan NOW
-            # (client-owned cleanup — a SIGKILLed shard can't do it)
-            wire.unlink_slab(old_slab)
         # a respawned shard restarts empty: re-base the watermark counter
         # on what it actually holds, so samplers' deferral stays consistent
         link.sent_rows = int(obj.get("ingested_rows", 0))
@@ -213,19 +142,11 @@ class ExperienceSender:
             # dedup compaction relies on
             self.dropped_rows += n
         link.inflight.clear()
-        link.negotiated = True
-        link.dead = False
-        link.failures = 0
         link.stale_resends = 0
         return True
 
     def _mark_dead(self, link: _ShardLink) -> bool:
-        link.dead = True
-        link.failures += 1
-        backoff = min(
-            self._respawn_cap, self._respawn_base * 2.0 ** (link.failures - 1)
-        )
-        link.next_attempt = time.monotonic() + backoff
+        link.schedule_backoff(self._respawn_base, self._respawn_cap)
         for slot, _f, n, _t in link.inflight.values():
             # undelivered rows die with the link (counted, never silent)
             self.dropped_rows += n
@@ -237,7 +158,7 @@ class ExperienceSender:
     def _revive(self, link: _ShardLink) -> bool:
         if link.negotiated and not link.dead:
             return True
-        if link.dead and time.monotonic() < link.next_attempt:
+        if not link.revive_due():
             return False
         # first contact gets the generous budget (a spawned shard is still
         # importing); revival probes are quick — the backoff schedule

@@ -166,6 +166,7 @@ class SessionHooks:
         # is single-controller for free.
         self._publisher = None
         self._param_server = None
+        self._fanout = None
         pub = cfg.get("publish", None)
         if pub is not None and pub.enabled:
             from surreal_tpu.agents import make_agent
@@ -183,6 +184,21 @@ class SessionHooks:
                 self._publisher.address, bind=pub.bind,
                 on_event=self.tracer.event,
             )
+            # parameter fanout (ISSUE 10, distributed/param_fanout.py):
+            # versioned weight FRAMES over pub/sub — one encode + N
+            # subscribes instead of N full-pytree fetch pickles, with
+            # delta/bf16 wire arms. The publisher/server pair above STAYS
+            # as the fallback/late-joiner fetch path. `.get` keeps old
+            # configs loadable.
+            fan = pub.get("fanout", None)
+            if fan is not None and fan.get("enabled", False):
+                from surreal_tpu.distributed.param_fanout import ParameterFanout
+
+                self._fanout = ParameterFanout(
+                    wire=str(fan.get("wire", "f32")),
+                    delta=bool(fan.get("delta", True)),
+                    ack_ttl_s=float(fan.get("ack_ttl_s", 60.0)),
+                )
             self._pub_every = PeriodicTracker(max(1, pub.every_n_iters))
             # discovery file: how `surreal_tpu actor` / `eval --follow`
             # find a live session without the operator copying ports
@@ -192,14 +208,15 @@ class SessionHooks:
 
             self._discovery_path = os.path.join(cfg.folder, "param_server.json")
             tmp_path = self._discovery_path + ".tmp"
+            discovery = {
+                "addresses": self._param_server.addresses,
+                "publisher": self._publisher.address,
+            }
+            if self._fanout is not None:
+                discovery["fanout"] = self._fanout.address
+                discovery["fanout_ack"] = self._fanout.ack_address
             with open(tmp_path, "w") as f:
-                json.dump(
-                    {
-                        "addresses": self._param_server.addresses,
-                        "publisher": self._publisher.address,
-                    },
-                    f,
-                )
+                json.dump(discovery, f)
             os.replace(tmp_path, self._discovery_path)
             self.log.info(
                 "parameter server live at %s (publish every %d iters)",
@@ -232,6 +249,13 @@ class SessionHooks:
             " ".join(f"{k}={v}" for k, v in sorted(info.items())),
         )
         self.tracer.event("data_plane", **info)
+
+    def serving_event(self, **info) -> None:
+        """Record the serving tier's per-replica snapshot (replica
+        liveness/budgets/serve latency, scale decisions) as one telemetry
+        ``serving_tier`` event per metrics row — ``surreal_tpu diag``'s
+        "Serving tier" section renders the last one."""
+        self.tracer.event("serving_tier", **info)
 
     def experience_event(self, **info) -> None:
         """Record the experience plane's settled shape (shard transports,
@@ -443,11 +467,18 @@ class SessionHooks:
             and not tripped  # never publish poisoned params to live actors
         ):
             with self.tracer.span("param-publish", emit=True):
-                version = self._publisher.publish(
-                    self._pub_agent.acting_view(resolve_state())
-                )
+                view = self._pub_agent.acting_view(resolve_state())
+                version = self._publisher.publish(view)
+                if self._fanout is not None:
+                    # broadcast the same view as a versioned frame
+                    # (full/delta/bf16 per the fanout knobs); the
+                    # publisher/server blob above stays the fetch
+                    # fallback for late joiners
+                    self._fanout.publish(view)
             if m is not None:
                 m["publish/version"] = float(version)
+                if self._fanout is not None:
+                    m.update(self._fanout.gauges())
                 self._last_train = m
         evaled: dict[str, float] = {}
         if (
@@ -581,6 +612,9 @@ class SessionHooks:
                 os.unlink(self._discovery_path)
             except OSError:
                 pass
+        if self._fanout is not None:
+            self._fanout.close()
+            self._fanout = None
         if self._publisher is not None:
             self._publisher.close()
             self._publisher = None

@@ -94,15 +94,17 @@ class _DataPlane:
         self.dwell_ms: deque = deque(maxlen=256)
         # exponential respawn backoff (satellite of ISSUE 5): a worker that
         # dies at startup used to respawn-loop hot — burning CPU on env
-        # construction and flooding the server with hellos. First death
-        # respawns immediately; consecutive deaths back off base * 2^k up
-        # to the cap; a respawn that survives _HEALTHY_S resets its streak.
-        self._backoff_base = float(respawn_backoff_s)
-        self._backoff_cap = float(respawn_backoff_cap_s)
-        now = time.monotonic()
-        self._failures = [0] * len(workers)
-        self._next_spawn_at = [0.0] * len(workers)
-        self._spawned_at = [now] * len(workers)
+        # construction and flooding the server with hellos. The schedule
+        # (immediate first respawn, base * 2^k capped, healthy-streak
+        # reset) is the shared utils/respawn.py state machine — one
+        # implementation for workers, experience shards, and inference
+        # replicas.
+        from surreal_tpu.utils.respawn import RespawnSchedule
+
+        self._sched = RespawnSchedule(
+            len(workers), respawn_backoff_s, respawn_backoff_cap_s,
+            healthy_s=self._HEALTHY_S,
+        )
         self.respawn_backoff_s = 0.0  # gauge: backoff set by the last respawn
         # supervision runs from the prefetch staging thread (empty-poll
         # waits) AND the trainer thread (drop path / post-learn): without
@@ -116,31 +118,27 @@ class _DataPlane:
         backoff schedule above. Safe because workers are stateless — a
         fresh worker re-opens its DEALER socket under the same identity
         and the server's first message from it (obs-only) replaces the
-        stale pending state without fabricating a transition."""
+        stale pending state without fabricating a transition.
+
+        With a serving TIER (``server`` is an InferenceFleet) the same
+        pass also supervises replicas, and a respawned worker routes via
+        ``address_for`` — a worker whose replica died re-hellos to a
+        SURVIVOR, not to the corpse's address."""
+        if hasattr(self.server, "supervise"):
+            self.server.supervise()
         with self._supervise_lock:
             now = time.monotonic()
             for i, w in enumerate(self.workers):
                 if w.is_alive():
-                    if (
-                        self._failures[i]
-                        and now - self._spawned_at[i] > self._HEALTHY_S
-                    ):
-                        self._failures[i] = 0
+                    self._sched.note_alive(i, now)
                     continue
-                if now < self._next_spawn_at[i]:
+                if not self._sched.due(i, now):
                     continue  # backing off a crash-looping worker
                 self.workers[i] = self.trainer._spawn_one(
-                    i, self.env_cfg, self.server.address, self.stop
+                    i, self.env_cfg, self.server, self.stop
                 )
                 self.respawns += 1
-                self._failures[i] += 1
-                self._spawned_at[i] = now
-                backoff = min(
-                    self._backoff_cap,
-                    self._backoff_base * (2.0 ** (self._failures[i] - 1)),
-                )
-                self._next_spawn_at[i] = now + backoff
-                self.respawn_backoff_s = backoff
+                self.respawn_backoff_s = self._sched.respawned(i, now)
 
     def next_chunk(self) -> dict:
         deadline = time.monotonic() + self._timeout
@@ -335,13 +333,21 @@ class SEEDTrainer:
             # NOT donated — same aliasing as above (see dp_learn's note)
             self._learn = jax.jit(self.learner.learn, donate_argnums=())
 
-    def _spawn_one(self, i: int, env_cfg, address, stop):
+    def _spawn_one(self, i: int, env_cfg, route, stop):
         """Start env worker ``i`` as a thread or subprocess.
+
+        ``route`` is the serving endpoint: a plain address string, or the
+        server/fleet object — whose ``address_for(i)`` applies the
+        session-affinity map (a fleet hashes workers over ALIVE replicas,
+        so a respawn after a replica death lands on a survivor).
 
         Process mode uses the ``spawn`` start method: forking after jax/zmq
         have started threads is unsafe, and workers only need numpy + the
         host env anyway.
         """
+        address = (
+            route.address_for(i) if hasattr(route, "address_for") else route
+        )
         kwargs = dict(
             transport=self.worker_transport,
             pipeline=self.pipeline_workers,
@@ -380,9 +386,9 @@ class SEEDTrainer:
         w.start()
         return w
 
-    def _spawn_workers(self, env_cfg, address, stop):
+    def _spawn_workers(self, env_cfg, route, stop):
         return [
-            self._spawn_one(i, env_cfg, address, stop)
+            self._spawn_one(i, env_cfg, route, stop)
             for i in range(self.num_workers)
         ]
 
@@ -394,19 +400,10 @@ class SEEDTrainer:
         from surreal_tpu.launch.hooks import training_env_config
 
         topo = self.config.session_config.topology
-        server = InferenceServer(
-            act_fn=act_fn,
+        common = dict(
             unroll_length=self.algo.horizon,
-            # coalesce all workers into one forward per lockstep round:
-            # with min_batch=1 a W-worker fleet degrades to ~W serves
-            # per round, and serve latency (not compute) is the bound.
-            # auto_tune keeps this true as the fleet shrinks/regrows
-            # (worker death, respawn) and scales the coalescing wait to
-            # the serve-latency EWMA.
-            min_batch=self.num_workers,
             max_wait_ms=5.0,
             transport="pickle" if self.worker_transport == "pickle" else "auto",
-            auto_tune=True,
             trace_id=self._trace_id,
             # robustness: nonfinite obs payloads (a corrupt slab slot, a
             # worker gone insane) are sanitized + counted rather than
@@ -414,11 +411,53 @@ class SEEDTrainer:
             # loadable.
             sanitize_obs=bool(topo.get("sanitize_obs", True)),
         )
+        # serving tier (ISSUE 10, distributed/fleet.py): >1 replica (or
+        # autoscale on) runs the replicated fleet with session-affinity
+        # routing and per-replica coalescing budgets; the single-server
+        # path below stays byte-identical to the pre-tier behavior.
+        fc = topo.get("inference_fleet", None)
+        n_replicas = int(fc.get("replicas", 1)) if fc is not None else 1
+        fleet_on = fc is not None and (
+            n_replicas > 1 or bool(fc.get("autoscale", False))
+        )
+        if fleet_on:
+            from surreal_tpu.distributed.fleet import InferenceFleet
+
+            server = InferenceFleet(
+                act_fn,
+                num_workers=self.num_workers,
+                replicas=n_replicas,
+                min_replicas=int(fc.get("min_replicas", 1)),
+                max_replicas=int(fc.get("max_replicas", 4)),
+                autoscale=bool(fc.get("autoscale", False)),
+                scale_up_serve_ms=float(fc.get("scale_up_serve_ms", 40.0)),
+                scale_down_serve_ms=float(fc.get("scale_down_serve_ms", 5.0)),
+                scale_cooldown_s=float(fc.get("scale_cooldown_s", 30.0)),
+                respawn_backoff_s=float(fc.get("respawn_backoff_s", 0.5)),
+                respawn_backoff_cap_s=float(
+                    fc.get("respawn_backoff_cap_s", 30.0)
+                ),
+                **common,
+            )
+        else:
+            server = InferenceServer(
+                act_fn=act_fn,
+                # coalesce all workers into one forward per lockstep
+                # round: with min_batch=1 a W-worker fleet degrades to ~W
+                # serves per round, and serve latency (not compute) is
+                # the bound. auto_tune keeps this true as the fleet
+                # shrinks/regrows (worker death, respawn) and scales the
+                # coalescing wait to the serve-latency EWMA. (The fleet
+                # installs per-REPLICA budgets from its affinity map.)
+                min_batch=self.num_workers,
+                auto_tune=True,
+                **common,
+            )
         try:
             env_cfg = self._worker_env_config(
                 training_env_config(self.config.env_config)
             )
-            workers = self._spawn_workers(env_cfg, server.address, stop)
+            workers = self._spawn_workers(env_cfg, server, stop)
         except BaseException:
             # a failed spawn must not leak the ROUTER socket + serve thread
             server.close()
@@ -707,6 +746,12 @@ class SEEDTrainer:
                     hooks.tracer.event(
                         "hops", **hop_event(server, plane, learn_ms)
                     )
+                    if hasattr(server, "maybe_autoscale"):
+                        # serving tier: one scale decision per cadence
+                        # (cooldown-bounded, driven by the serve-latency
+                        # EWMA) + the per-replica telemetry snapshot
+                        server.maybe_autoscale()
+                        hooks.serving_event(**server.tier_event())
                     if xplane is not None:
                         xplane._poll_stats()
                         hooks.experience_event(**xplane.telemetry_event())

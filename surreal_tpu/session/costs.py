@@ -96,6 +96,40 @@ GAUGE_REGISTRY = {
         "transitions dropped after the sender's bounded retry budget "
         "exhausted against a dead shard."
     ),
+    # -- serving tier (distributed/fleet.py; fleet aggregates) --------------
+    "fleet/replicas_live": "inference-server replicas currently alive.",
+    "fleet/respawns": (
+        "replica respawns performed by the fleet supervisor this run "
+        "(in place, fixed address, exponential backoff)."
+    ),
+    "fleet/scale_ups": "autoscale replica additions this run.",
+    "fleet/scale_downs": "autoscale replica drains this run.",
+    "fleet/serve_ms": (
+        "fleet-mean serve-latency EWMA — the autoscaler's up/down signal."
+    ),
+    "fleet/queue_depth": "summed trajectory-chunk queue depth across replicas.",
+    # -- parameter fanout (distributed/param_fanout.py) ---------------------
+    "param/publishes": "weight frames broadcast by the fanout this run.",
+    "param/full_frames": "full (key) frames among them.",
+    "param/delta_frames": "delta frames among them.",
+    "param/rekeys": (
+        "full frames FORCED by a stale/absent subscriber ack (a dropped "
+        "frame or late joiner re-keys the delta stream)."
+    ),
+    "param/bytes_last_publish": "wire bytes of the newest frame.",
+    "param/bytes_published": "cumulative fanout wire bytes this run.",
+    "param/subscribers": "subscribers with a fresh (ttl-bounded) ack.",
+    # subscriber-side counters (ParameterSubscriber.gauges — actor/eval
+    # processes and tests; not part of the trainer's metrics rows)
+    "param/applied_frames": "frames this subscriber applied.",
+    "param/stale_frames": (
+        "inapplicable deltas this subscriber dropped (missed frame / "
+        "fresh join) — each flags needs_resync toward the fetch fallback."
+    ),
+    "param/fallback_fetches": (
+        "ParameterClient.fetch catch-ups this subscriber performed "
+        "(the late-joiner / dropped-frame path; counted, never silent)."
+    ),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

@@ -228,6 +228,29 @@ BASE_SESSION_CONFIG = Config(
             respawn_backoff_s=0.5,
             respawn_backoff_cap_s=30.0,
         ),
+        # autoscaling act-serving tier (distributed/fleet.py): replicas>1
+        # (or autoscale=true) replaces the single InferenceServer with an
+        # InferenceFleet — N replicas behind session-affinity routing
+        # (workers rendezvous-hash to a replica at spawn and stay there,
+        # so trajectory streams and shm slabs keep one owner), each with
+        # its OWN coalescing budget (min_batch = its affinity share of
+        # the worker fleet; auto_tune tracks per-replica liveness).
+        # Lifecycle is the SEED respawn schedule: a dead replica respawns
+        # in place (fixed address) under base * 2^k backoff while its
+        # workers re-hello to survivors. Autoscaling adds/drains replicas
+        # off the serve-latency EWMA (the PR-1 gauge), cooldown-bounded,
+        # within [min_replicas, max_replicas].
+        inference_fleet=Config(
+            replicas=1,               # 1 = the original single server
+            min_replicas=1,
+            max_replicas=4,
+            autoscale=False,
+            scale_up_serve_ms=40.0,   # fleet-mean serve EWMA above: add
+            scale_down_serve_ms=5.0,  # ...below: drain one replica
+            scale_cooldown_s=30.0,    # min seconds between decisions
+            respawn_backoff_s=0.5,
+            respawn_backoff_cap_s=30.0,
+        ),
         # host-env (gym/dm_control) loops: collect iteration k+1 on a
         # worker thread while the device learns on k (the reference's
         # learner never waited on actors — its prefetch thread kept
@@ -388,6 +411,20 @@ BASE_SESSION_CONFIG = Config(
         bind="tcp://127.0.0.1:*",  # REP endpoint(s) served to actor/eval
                                    # clients; set a real interface for
                                    # cross-machine actors
+        # parameter FANOUT (distributed/param_fanout.py): versioned
+        # weight frames over pub/sub — publish bytes scale with one
+        # encode + N subscribes instead of N full-pytree fetch pickles.
+        # wire='bf16' casts floating leaves to bfloat16 on the wire (f32
+        # reconstruct, ops/precision.py's bf16 dtype); delta=true encodes
+        # zlib'd deltas against the subscribers' acked version (a stale
+        # ack re-keys with a full frame; a subscriber that missed a frame
+        # falls back to ParameterClient.fetch — counted, never silent).
+        fanout=Config(
+            enabled=False,
+            wire="f32",      # 'f32' | 'bf16'
+            delta=True,
+            ack_ttl_s=60.0,  # acks older than this don't pin full frames
+        ),
     ),
     seed=0,
 )

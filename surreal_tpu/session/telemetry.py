@@ -86,6 +86,13 @@ line, ``t`` = unix seconds):
                     (parameter-service hop: span-tagged client fetches
                      mirrored by ParameterServer when SessionHooks owns
                      it)
+    {"type": "serving_tier", "t": ..., "replicas": {"0": {state,
+     address, min_batch, serve_ms, workers, queue_depth, ...}, ...},
+     "autoscale": ..., "num_workers": N, "fleet/...": ...}
+                    (the act-serving tier's per-replica snapshot —
+                     distributed/fleet.py, one per metrics row while an
+                     InferenceFleet is active; rendered by diag's
+                     "Serving tier" section)
     {"type": "experience_plane", "t": ..., "kind": "...",
      "num_shards": N, "shard_mode": "...", "transports": [...],
      "shards": {"0": {fill, ingested_rows, samples_served,
@@ -378,6 +385,7 @@ def diag_summary(folder: str) -> dict | None:
     compile_cache = None
     data_plane = None
     experience = None
+    serving = None
     trace_id = None
     programs: dict[str, dict] = {}   # program_cost events (last per name)
     precision = None                 # last 'precision' event (active policy)
@@ -423,6 +431,12 @@ def diag_summary(folder: str) -> dict | None:
             # the last event is the settled negotiation (SEED drivers emit
             # one after the first learn and one at run end)
             data_plane = {
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "serving_tier":
+            # the last event is the settled tier shape (one per metrics
+            # row while an InferenceFleet is active)
+            serving = {
                 k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
         elif ev.get("type") == "experience_plane":
@@ -540,6 +554,7 @@ def diag_summary(folder: str) -> dict | None:
         "compile_cache": compile_cache,
         "data_plane": data_plane,
         "experience": experience,
+        "serving": serving,
         "tune": tune,
         "tune_hits": tune_hits,
         "tune_misses": tune_misses,
@@ -615,6 +630,9 @@ def diag_report(folder: str) -> str | None:
             "Data plane — "
             + ", ".join(f"{k}={dpl[k]}" for k in sorted(dpl)),
         ]
+    tier_lines = _serving_tier_lines(s)
+    if tier_lines:
+        lines += ["", "Serving tier"] + tier_lines
     xp_lines = _experience_plane_lines(s)
     if xp_lines:
         lines += ["", "Experience plane"] + xp_lines
@@ -721,6 +739,47 @@ def diag_report(folder: str) -> str | None:
     else:
         lines.append("  (none recorded — single-host session)")
     return "\n".join(lines)
+
+
+def _serving_tier_lines(s: dict) -> list[str]:
+    """The diag 'Serving tier' section: replica liveness/budget table,
+    fleet-mean serve latency, scale/respawn counters from the last
+    ``serving_tier`` event. Empty list when the session ran no fleet."""
+    tier = s.get("serving")
+    if not tier:
+        return []
+    lines = [
+        "  {n} replica(s) alive over {w} workers — respawns {r:g}, "
+        "scale ups {u:g} / downs {d:g}, autoscale {a}".format(
+            n=int(tier.get("fleet/replicas_live", 0)),
+            w=tier.get("num_workers", "?"),
+            r=float(tier.get("fleet/respawns", 0)),
+            u=float(tier.get("fleet/scale_ups", 0)),
+            d=float(tier.get("fleet/scale_downs", 0)),
+            a="on" if tier.get("autoscale") else "off",
+        ),
+    ]
+    if tier.get("fleet/serve_ms") is not None:
+        lines.append(
+            f"  fleet serve EWMA {float(tier['fleet/serve_ms']):.2f} ms, "
+            f"queue depth {float(tier.get('fleet/queue_depth', 0)):g}"
+        )
+    replicas = tier.get("replicas") or {}
+    if replicas:
+        lines.append(
+            f"  {'replica':>8} {'state':<8} {'workers':>8} "
+            f"{'min_batch':>10} {'serve ms':>9} {'evicted':>8}"
+        )
+        for rid in sorted(replicas, key=lambda x: int(x)):
+            r = replicas[rid]
+            serve = r.get("serve_ms")
+            lines.append(
+                f"  {rid:>8} {r.get('state', '?'):<8} "
+                f"{r.get('workers', 0):>8} {r.get('min_batch', 0):>10} "
+                + (f"{float(serve):>9.2f}" if serve is not None else f"{'n/a':>9}")
+                + f" {r.get('evicted_chunks', 0):>8}"
+            )
+    return lines
 
 
 def _experience_plane_lines(s: dict) -> list[str]:

@@ -59,6 +59,21 @@ Sites and the kinds they honor:
                          frame bytes — the shard must count+drop it and
                          the ack retry must redeliver; ``drop_frame`` /
                          ``delay_frame`` as on transport.send)
+    fleet.replica        once per inference-server loop pass
+                         (``kill_replica``: raise FaultInjected in the
+                         serve thread — the replica dies like a crash,
+                         its workers re-hello to fleet survivors and the
+                         fleet supervisor respawns it in place;
+                         ``delay``: sleep ``ms``)
+    param.publish        every parameter-fanout publish
+                         (``delay_publish``: sleep ``ms`` before the
+                         broadcast; ``drop_frame``: swallow the frame on
+                         the wire — subscribers miss the version, the
+                         publisher's next publish re-keys with a FULL
+                         frame off their stale acks, and a subscriber
+                         that sees the gap first falls back to
+                         ``ParameterClient.fetch`` — counted, never
+                         silent)
 
 Config wiring: ``session_config.faults.plan`` (a list of spec dicts, or a
 JSON string of one for ``--set`` CLI overrides). Drivers call
@@ -96,6 +111,8 @@ SITES = frozenset(
         "experience.shard",
         "experience.sample",
         "experience.send",
+        "fleet.replica",
+        "param.publish",
     }
 )
 

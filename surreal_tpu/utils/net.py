@@ -1,0 +1,18 @@
+"""Small shared networking helpers for the host-side service planes
+(experience shards, inference-fleet replicas)."""
+
+from __future__ import annotations
+
+import socket
+
+
+def alloc_address(host: str = "127.0.0.1") -> str:
+    """Pick a free loopback port (bind-then-close) for a FIXED service
+    address: the parent allocates it up front so a respawned shard or
+    replica binds the SAME endpoint and clients' DEALERs reconnect in
+    place — no rendezvous service. The small bind-then-close TOCTOU
+    window is accepted (the --local-procs coordinator's rule): a lost
+    race surfaces as a bind failure and a supervised respawn."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return f"tcp://{host}:{s.getsockname()[1]}"

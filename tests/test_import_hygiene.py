@@ -278,6 +278,12 @@ _DATA_PLANE_STEADY_STATE = (
     "experience/shard.py",
     "experience/sender.py",
     "experience/sampler.py",
+    "experience/link.py",
+    # the serving tier + parameter fanout (ISSUE 10): frames are raw
+    # struct/zlib codecs, never pickled pytrees (module_dict's msgpack
+    # is the fetch fallback's wire format, not pickle)
+    "distributed/fleet.py",
+    "distributed/param_fanout.py",
     "experience/plane.py",
     "launch/offpolicy_trainer.py",
 )
@@ -346,9 +352,10 @@ def test_no_swallowed_exceptions_in_supervised_code():
 
 def test_perf_gauges_appear_in_registry():
     """Gauge-registry lint (ISSUE 6 satellite, extended by ISSUE 8 over
-    the replay/experience families): every ``perf/*``, ``replay/*``, or
-    ``experience/*`` gauge name emitted anywhere in the package must
-    appear in the documented registry
+    the replay/experience families and ISSUE 10 over the serving-tier
+    fleet/param families): every ``perf/*``, ``replay/*``,
+    ``experience/*``, ``fleet/*``, or ``param/*`` gauge name emitted
+    anywhere in the package must appear in the documented registry
     (``session/costs.py::GAUGE_REGISTRY``) — an undocumented gauge is
     invisible to diag readers and to the README's knob table. The scan
     covers string literals, so a gauge built by concatenation would dodge
@@ -358,7 +365,9 @@ def test_perf_gauges_appear_in_registry():
 
     from surreal_tpu.session.costs import GAUGE_REGISTRY
 
-    lit = re.compile(r"[\"']((?:perf|replay|experience)/[a-z0-9_]+)[\"']")
+    lit = re.compile(
+        r"[\"']((?:perf|replay|experience|fleet|param)/[a-z0-9_]+)[\"']"
+    )
     bad = []
     for path in sorted(_PKG_ROOT.rglob("*.py")):
         if path.name == "costs.py":
@@ -371,12 +380,14 @@ def test_perf_gauges_appear_in_registry():
                     f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
                 )
     assert not bad, (
-        "perf/replay/experience gauges emitted but not documented in "
-        "session/costs.py::GAUGE_REGISTRY:\n" + "\n".join(bad)
+        "perf/replay/experience/fleet/param gauges emitted but not "
+        "documented in session/costs.py::GAUGE_REGISTRY:\n" + "\n".join(bad)
     )
     # and the registry names must parse as gauge literals themselves
     for name in GAUGE_REGISTRY:
-        assert name.startswith(("perf/", "replay/", "experience/")), name
+        assert name.startswith(
+            ("perf/", "replay/", "experience/", "fleet/", "param/")
+        ), name
 
 
 def test_graft_entry_import_initializes_no_backend():
