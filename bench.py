@@ -286,6 +286,14 @@ def main() -> int:
         from perf_wallclock import act_path_main
 
         return act_path_main(sys.argv[1:])
+    if "--gateway" in sys.argv:
+        # session-gateway campaign (ISSUE 12): attach latency, act RTT
+        # through the gateway vs direct-to-fleet, act-cache hit/served
+        # split — writes BENCH_gateway.json (perf_gate's gateway gate
+        # consumes it)
+        from perf_wallclock import gateway_main
+
+        return gateway_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

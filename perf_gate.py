@@ -322,12 +322,79 @@ def gate_act(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_gateway(art_dir: str, out=sys.stdout) -> int:
+    """Session-gateway gate (ISSUE 12): when a committed
+    ``BENCH_gateway.json`` exists (``bench.py --gateway``), enforce the
+    tier's two commitments on the image it was measured on:
+
+    - the session tier does not double act latency: gateway act RTT p50
+      stays <= ``rtt_ratio_max`` x the direct in-process ``serve_act``
+      p50 (2.0 on a one-core box, where the client, the gateway loop,
+      and the fleet contend for the same core — the wire round-trip
+      rides on top of the SAME policy forward the direct arm times);
+    - a cache hit is strictly faster than a served act: the act cache's
+      value claim is skipping the forward, so hit p50 must sit BELOW
+      served p50 at the duplicated-obs workload.
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_gateway.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_gateway.json — session gateway not "
+              "measured (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_gateway.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    # default mirrors the producer's bound (perf_wallclock.py
+    # GW_RTT_RATIO_MAX) so a field-less artifact can't flip the verdict
+    bound = float(data.get("rtt_ratio_max", 2.0))
+    rtt = (data.get("act_rtt_ms") or {}).get("p50")
+    direct = (data.get("direct_ms") or {}).get("p50")
+    # `is not None`, not truthiness: a MEASURED 0.0 direct p50 means the
+    # ratio is meaningless — skip with a note rather than divide
+    if rtt is not None and direct is not None and float(direct) > 0:
+        ratio = float(rtt) / float(direct)
+        line = (
+            f"perf_gate: gateway act RTT p50 {float(rtt):.3f} ms vs "
+            f"direct {float(direct):.3f} ms (ratio {ratio:.3f}, "
+            f"commitment <= {bound:.1f}x on a one-core box)"
+        )
+        if ratio > bound:
+            print(line + " — GATEWAY DOUBLES ACT LATENCY", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    cache = data.get("cache") or {}
+    hit = (cache.get("hit_ms") or {}).get("p50")
+    served = (cache.get("served_ms") or {}).get("p50")
+    if hit is not None and served is not None:
+        line = (
+            f"perf_gate: gateway cache hit p50 {float(hit):.3f} ms vs "
+            f"served {float(served):.3f} ms at hit-rate "
+            f"{float(cache.get('hit_rate', 0)):.2f} "
+            "(commitment: strictly below)"
+        )
+        if float(hit) >= float(served):
+            print(line + " — HIT NOT FASTER THAN A FORWARD", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    return rc
+
+
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
-    # the experience-plane and act-path gates are independent of the
-    # BENCH_r* trail: run them first and fold their verdicts into every
-    # return path
+    # the experience-plane, act-path, and gateway gates are independent
+    # of the BENCH_r* trail: run them first and fold their verdicts into
+    # every return path
     xp_rc = max(
-        gate_experience(art_dir, out=out), gate_act(art_dir, out=out)
+        gate_experience(art_dir, out=out), gate_act(art_dir, out=out),
+        gate_gateway(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

@@ -49,6 +49,7 @@ import queue
 import threading
 import time
 import zlib
+from collections import OrderedDict
 from typing import Callable
 
 from surreal_tpu.distributed.inference_server import InferenceServer
@@ -95,11 +96,22 @@ class InferenceFleet:
         scale_cooldown_s: float = 30.0,
         respawn_backoff_s: float = 0.5,
         respawn_backoff_cap_s: float = 30.0,
+        act_history: int = 8,
     ):
         if replicas < 1:
             raise ValueError(f"inference_fleet.replicas must be >= 1, got {replicas}")
         self._act_fn = act_fn
         self._version = 0
+        # bounded {version -> act closure} history: the gateway's
+        # version-pinned serves ask for "the policy that WAS version V"
+        # after set_act_fn moved the replicas on — a pin is a hold on the
+        # closure, not a fleet-wide rollback. Oldest-evicted; a pin that
+        # outlives the window surfaces as a counted catch_up (never a
+        # silent version jump).
+        self._act_history: "OrderedDict[int, Callable]" = OrderedDict(
+            {0: act_fn}
+        )
+        self._act_history_limit = max(1, int(act_history))
         self.num_workers = int(num_workers)
         self.trace_id = trace_id
         # ONE shared output queue for every replica (injected at spawn):
@@ -312,12 +324,59 @@ class InferenceFleet:
         respawned replica is re-synced from)."""
         self._act_fn = act_fn
         self._version += 1
+        self._act_history[self._version] = act_fn
+        while len(self._act_history) > self._act_history_limit:
+            self._act_history.popitem(last=False)
         for srv in self.servers():
             srv.set_act_fn(act_fn)
 
     @property
     def version(self) -> int:
         return self._version
+
+    def held_versions(self) -> list[int]:
+        """Param versions whose act closures the fleet still holds (the
+        gateway's pinnable set)."""
+        return list(self._act_history)
+
+    def serve_act(self, obs, *, replica: int | None = None,
+                  version: int | None = None):
+        """Gateway ingress: one synchronous forward in the CALLER's
+        thread — the session tier's act path, separate from the workers'
+        coalesced serve loop. Returns ``(actions, served_version)``.
+
+        ``replica`` targets a bound slot (session affinity); a dead or
+        drained slot raises ``LookupError`` so the gateway rebinds from
+        its table instead of silently serving elsewhere. ``version``
+        pins the forward to a held closure from the act-fn history;
+        an evicted version raises ``KeyError`` — the gateway's counted
+        catch_up path, never a silent jump."""
+        import numpy as np
+
+        slot = self.replica_of(0) if replica is None else int(replica)
+        srv = (
+            self._replicas[slot]
+            if 0 <= slot < len(self._replicas) else None
+        )
+        if srv is None or not srv.alive:
+            raise LookupError(f"replica {slot} is not alive")
+        if version is None or version == self._version:
+            # current policy: serialize against set_act_fn's swap (the
+            # replica's own serve discipline)
+            with srv._act_lock:
+                actions, _ = srv._act_fn(obs)
+                served = srv._version
+        else:
+            fn = self._act_history.get(int(version))
+            if fn is None:
+                raise KeyError(
+                    f"param version {version} evicted from the act "
+                    f"history (held: {self.held_versions()})"
+                )
+            # a held closure is immutable — no lock needed
+            actions, _ = fn(obs)
+            served = int(version)
+        return np.asarray(actions), served
 
     def episode_stats(self) -> dict[str, float] | None:
         stats = [s.episode_stats() for s in self.servers()]
@@ -348,6 +407,13 @@ class InferenceFleet:
         ]
         if occ:
             out["pipeline_occupancy"] = sum(occ) / len(occ)
+        # per-replica held param versions, min/max (ISSUE 12 satellite:
+        # the gateway's pinned routing needs to see what the tier holds;
+        # a respawn lag shows up as min < max)
+        versions = [s.version for s in servers]
+        if versions:
+            out["param_version_min"] = float(min(versions))
+            out["param_version_max"] = float(max(versions))
         return out
 
     def queue_stats(self) -> dict[str, float]:
@@ -442,6 +508,10 @@ class InferenceFleet:
                 "min_batch": srv.min_batch,
                 "serve_ms": srv._serve_ms_ewma,
                 "workers": len(srv.worker_traces()),
+                # the param version THIS replica serves (the gateway's
+                # pinned-routing input; == fleet.version once the
+                # set_act_fn broadcast / respawn re-sync landed)
+                "param_version": srv.version,
                 # the chunk queue is fleet-shared (fleet/queue_depth);
                 # evictions stay per-replica (who hit the full queue)
                 "evicted_chunks": srv.evicted_chunks,

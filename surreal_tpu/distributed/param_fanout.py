@@ -210,6 +210,13 @@ class ParameterFanout:
         self._shadow: list[np.ndarray] | None = None
         self._shadow_version = 0
         self._acked: dict[str, tuple[int, float]] = {}  # id -> (ver, t)
+        # pinned-version holds (ISSUE 12): {version -> (refcount, full
+        # FRAME bytes)} — while a gateway session is pinned to V, the
+        # publisher retains V as an immediately-decodable full frame so
+        # a replica/subscriber catching a pinned session up never needs
+        # the pinned version to still be the live one. Ref-counted;
+        # release drops the snapshot.
+        self._held: dict[int, tuple[int, bytes]] = {}
         self.frames = 0
         self.full_frames = 0
         self.delta_frames = 0
@@ -295,6 +302,50 @@ class ParameterFanout:
         self._pub.send_multipart([TOPIC, frame])
         return {"version": self.version, "bytes": len(frame), "kind": kind}
 
+    # -- pinned-version holds (ISSUE 12: the gateway's version pins) ---------
+    def pin_version(self, version: int | None = None) -> int:
+        """Hold ``version`` (default: the current one) as a decodable
+        FULL frame until every pin on it is released. Only the current
+        shadow can be snapshotted — pinning a version the publisher has
+        already moved past raises ``KeyError`` unless it is already
+        held (then the refcount bumps)."""
+        v = self.version if version is None else int(version)
+        held = self._held.get(v)
+        if held is not None:
+            self._held[v] = (held[0] + 1, held[1])
+            return v
+        if v != self.version or self._shadow is None or self._codec is None:
+            raise KeyError(
+                f"version {v} is not the current shadow "
+                f"({self._shadow_version}) and holds no snapshot"
+            )
+        frame, _ = self._codec.encode(v, self._shadow, wire=self.wire)
+        self._held[v] = (1, frame)
+        return v
+
+    def release_pin(self, version: int) -> None:
+        """Drop one pin on ``version``; the last release frees the held
+        frame. Releasing an unheld version is a no-op (a crashed pinner
+        must not wedge shutdown)."""
+        v = int(version)
+        held = self._held.get(v)
+        if held is None:
+            return
+        if held[0] <= 1:
+            del self._held[v]
+        else:
+            self._held[v] = (held[0] - 1, held[1])
+
+    def held_frame(self, version: int) -> bytes | None:
+        """The retained full frame for a pinned version (a subscriber
+        catching a pinned session up decodes it like any wire frame)."""
+        held = self._held.get(int(version))
+        return held[1] if held is not None else None
+
+    @property
+    def holds(self) -> int:
+        return len(self._held)
+
     def gauges(self) -> dict[str, float]:
         """The ``param/*`` gauge family (GAUGE_REGISTRY documents each)."""
         return {
@@ -305,6 +356,7 @@ class ParameterFanout:
             "param/bytes_last_publish": float(self.last_bytes),
             "param/bytes_published": float(self.bytes_published),
             "param/subscribers": float(self.subscribers),
+            "param/holds": float(self.holds),
         }
 
     def close(self) -> None:

@@ -1,0 +1,22 @@
+"""Production session gateway (ISSUE 12): the tenant-facing session
+tier in front of the inference fleet — attach/detach sessions with
+leases, per-tenant admission control, migrating session state, version
+pinning, and a bounded act cache.
+
+Pieces:
+
+- :mod:`surreal_tpu.gateway.protocol` — the wire codec (the PR-8
+  experience-wire hello promoted to a public attach/detach protocol)
+  and the tenant-side :class:`GatewaySession` client;
+- :mod:`surreal_tpu.gateway.admission` — token buckets, session quotas,
+  bounded backpressure queues (counted, never silent);
+- :mod:`surreal_tpu.gateway.table` — the session table + its
+  incremental wire-frame checkpoint and the replica-death rebind;
+- :mod:`surreal_tpu.gateway.server` — the ROUTER loop tying it to
+  ``distributed/fleet.py`` (version-aware ``serve_act`` ingress).
+"""
+
+from surreal_tpu.gateway.protocol import GatewayError, GatewaySession
+from surreal_tpu.gateway.server import GatewayServer
+
+__all__ = ["GatewayError", "GatewaySession", "GatewayServer"]

@@ -1,0 +1,354 @@
+"""Gateway session wire codec + the tenant-side ``GatewaySession`` client
+(ISSUE 12 tentpole, piece 1).
+
+The PR-8 experience wire promoted to a PUBLIC attach/detach protocol: the
+hello handshake becomes a session attach (id + lease), act request/reply
+frames become length-framed structs, and the same one-sniff routing rule
+applies — MAGIC-prefixed control/struct frames for the tcp arm, whole
+pickled dicts for the negotiated per-session fallback. ``pickle.dumps``/
+``loads`` of payload data live ONLY in this module (the
+``experience/wire.py`` discipline; ``tests/test_import_hygiene.py`` lints
+the other ``surreal_tpu/gateway/`` modules for it).
+
+Frames (single ZMQ frames after the DEALER ident):
+
+- **GHELLO** (JSON): tenant, optional session id (re-attach after client
+  churn — the gateway OWNS the session table, so the binding survives),
+  obs geometry (shape/dtype — negotiated once, so steady-state ACT frames
+  carry raw bytes with no per-frame metadata), transport, optional
+  version pin, trace id.
+- **GHELLO_OK / GHELLO_NO** (JSON): granted session id + lease, or the
+  counted rejection reason (quota, capacity).
+- **ACT**: struct header (session id, seq, flags, t_send) + raw obs
+  bytes. ``seq`` makes the bounded client resend idempotent-enough: a
+  reply lost to chaos (``gateway.session`` ``drop_frame``) is simply
+  re-served — acting twice on the same obs is harmless, losing the
+  session is not.
+- **ACT_OK**: struct header (seq, served param version, flags, action
+  meta length, t_send) + JSON action meta (shape/dtype) + raw action
+  bytes. The served VERSION rides every reply — a pin that had to be
+  abandoned is visible (F_UNPINNED), never silent.
+- **ACT_ERR** (JSON): seq + reason — admission throttle evictions and
+  dead-session errors are replies, not silences.
+- **DETACH / DETACH_OK** (JSON).
+- **JOURNAL** (JSON): one session-table mutation, the incremental
+  checkpoint frame ``gateway/table.py`` ships over the experience wire.
+
+Any frame from a session renews its lease (``gateway/admission.py``
+reaps the idle).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+import time
+import uuid
+from typing import Any
+
+import numpy as np
+import zmq
+
+MAGIC = b"\xa5GW1"
+GHELLO = 1
+GHELLO_OK = 2
+GHELLO_NO = 3
+ACT = 4
+ACT_OK = 5
+ACT_ERR = 6
+DETACH = 7
+DETACH_OK = 8
+JOURNAL = 9
+
+# session ids are fixed-width (uuid4 hex prefix) so the ACT header stays
+# a fixed struct — no per-frame length fields on the hot path
+SID_BYTES = 16
+
+_ACT_HDR = struct.Struct(f"<{SID_BYTES}sIBd")   # sid, seq, flags, t_send
+_ACTOK_HDR = struct.Struct("<IQBHd")  # seq, version, flags, meta_len, t_send
+
+# ACT_OK flags
+F_CACHED = 1    # served from the (version, obs-digest) act cache
+F_UNPINNED = 2  # the session's pin was abandoned (catch_up) this reply
+
+
+def new_session_id() -> str:
+    return uuid.uuid4().hex[:SID_BYTES]
+
+
+def encode_hello(tenant: str, *, session: str | None = None,
+                 obs_shape=(), obs_dtype: str = "<f4",
+                 transport: str = "tcp", pin_version: int | None = None,
+                 trace: str | None = None) -> bytes:
+    return MAGIC + bytes([GHELLO]) + json.dumps(
+        {
+            "tenant": str(tenant),
+            "session": session,
+            "obs_shape": [int(d) for d in obs_shape],
+            "obs_dtype": str(obs_dtype),
+            "transport": transport,
+            "pin_version": pin_version,
+            "trace": trace,
+        }
+    ).encode()
+
+
+def encode_hello_ok(session: str, lease_s: float, transport: str,
+                    replica: int, pinned_version: int | None = None) -> bytes:
+    return MAGIC + bytes([GHELLO_OK]) + json.dumps(
+        {
+            "session": session,
+            "lease_s": float(lease_s),
+            "transport": transport,
+            "replica": int(replica),
+            "pinned_version": pinned_version,
+        }
+    ).encode()
+
+
+def encode_hello_no(reason: str) -> bytes:
+    return MAGIC + bytes([GHELLO_NO]) + json.dumps(
+        {"reason": reason}
+    ).encode()
+
+
+def encode_act(session: str, seq: int, obs: np.ndarray,
+               t_send: float = 0.0) -> bytes:
+    sid = session.encode()
+    if len(sid) != SID_BYTES:
+        raise ValueError(f"session id must be {SID_BYTES} bytes, got {sid!r}")
+    return (
+        MAGIC + bytes([ACT])
+        + _ACT_HDR.pack(sid, seq & 0xFFFFFFFF, 0, t_send)
+        + np.ascontiguousarray(obs).tobytes()
+    )
+
+
+def encode_act_ok(seq: int, version: int, actions: np.ndarray,
+                  flags: int = 0, t_send: float = 0.0) -> bytes:
+    arr = np.ascontiguousarray(actions)
+    meta = json.dumps(
+        {"shape": list(arr.shape), "dtype": arr.dtype.str}
+    ).encode()
+    return (
+        MAGIC + bytes([ACT_OK])
+        + _ACTOK_HDR.pack(seq & 0xFFFFFFFF, int(version), flags,
+                          len(meta), t_send)
+        + meta
+        + arr.tobytes()
+    )
+
+
+def encode_act_err(seq: int, reason: str, session: str = "") -> bytes:
+    return MAGIC + bytes([ACT_ERR]) + json.dumps(
+        {"seq": int(seq), "reason": reason, "session": session}
+    ).encode()
+
+
+def encode_detach(session: str) -> bytes:
+    return MAGIC + bytes([DETACH]) + json.dumps(
+        {"session": session}
+    ).encode()
+
+
+def encode_detach_ok(session: str, acts: int) -> bytes:
+    return MAGIC + bytes([DETACH_OK]) + json.dumps(
+        {"session": session, "acts": int(acts)}
+    ).encode()
+
+
+def encode_journal(op: dict) -> bytes:
+    """One session-table mutation as a wire frame — the incremental
+    checkpoint the table ships over the experience wire (any transport
+    that moves bytes moves these)."""
+    return MAGIC + bytes([JOURNAL]) + json.dumps(op).encode()
+
+
+def decode_payload(payload: bytes) -> tuple[str, Any]:
+    """Route one gateway frame -> (kind, obj): parsed JSON for control
+    frames, a header dict (with a ``body`` memoryview) for ACT/ACT_OK,
+    or the unpickled dict for 'msg' — the pickle fallback, deserialized
+    HERE, the one place the gateway may unpickle."""
+    if payload[:4] == MAGIC:
+        kind = payload[4]
+        body = memoryview(payload)[5:]
+        if kind in (GHELLO, GHELLO_OK, GHELLO_NO, DETACH, DETACH_OK,
+                    ACT_ERR, JOURNAL):
+            name = {
+                GHELLO: "hello", GHELLO_OK: "hello_ok",
+                GHELLO_NO: "hello_no", DETACH: "detach",
+                DETACH_OK: "detach_ok", ACT_ERR: "act_err",
+                JOURNAL: "journal",
+            }[kind]
+            return name, json.loads(bytes(body).decode())
+        if kind == ACT:
+            sid, seq, flags, t_send = _ACT_HDR.unpack_from(body, 0)
+            return "act", {
+                "session": sid.decode(), "seq": seq, "flags": flags,
+                "t_send": t_send, "body": body[_ACT_HDR.size:],
+            }
+        if kind == ACT_OK:
+            seq, version, flags, meta_len, t_send = _ACTOK_HDR.unpack_from(
+                body, 0
+            )
+            off = _ACTOK_HDR.size
+            meta = json.loads(bytes(body[off:off + meta_len]).decode())
+            return "act_ok", {
+                "seq": seq, "version": version, "flags": flags,
+                "t_send": t_send, "meta": meta,
+                "body": body[off + meta_len:],
+            }
+        raise ValueError(f"unknown gateway frame kind {kind}")
+    return "msg", pickle.loads(payload)
+
+
+def encode_pickle_msg(msg: dict) -> bytes:
+    """Fallback-transport message (whole dict, ndarray payloads included)."""
+    return pickle.dumps(msg, protocol=5)
+
+
+def decode_act_ok(obj: dict) -> tuple[np.ndarray, dict]:
+    """ACT_OK header dict -> (actions, info). Copies out of the frame
+    (the reply buffer does not outlive the call)."""
+    meta = obj["meta"]
+    actions = np.frombuffer(
+        obj["body"], np.dtype(meta["dtype"])
+    ).reshape(meta["shape"]).copy()
+    return actions, {
+        "param_version": int(obj["version"]),
+        "cached": bool(obj["flags"] & F_CACHED),
+        "unpinned": bool(obj["flags"] & F_UNPINNED),
+    }
+
+
+class GatewayError(RuntimeError):
+    """A counted gateway rejection (admission, eviction, dead session)."""
+
+
+class GatewaySession:
+    """Tenant-side session handle: attach at construction, ``act`` per
+    observation, ``detach``/``close`` when done.
+
+    Delivery: ``act`` sends one frame and waits for ITS seq; a reply
+    lost on the wire (chaos ``drop_frame``, a migrating replica) is
+    covered by a bounded resend against the same session/seq — the
+    gateway re-serves, the stream continues, and a stale duplicate
+    reply from an earlier attempt is drained by seq mismatch. Retries
+    exhausted raise ``TimeoutError`` (the caller's supervisor decides);
+    admission rejections raise :class:`GatewayError` with the counted
+    reason."""
+
+    def __init__(self, address: str, tenant: str = "default", *,
+                 session: str | None = None, obs_shape=(),
+                 obs_dtype: str = "<f4", transport: str = "tcp",
+                 pin_version: int | None = None, trace: str | None = None,
+                 timeout_s: float = 5.0, retries: int = 3):
+        if transport not in ("tcp", "pickle"):
+            raise ValueError(f"transport {transport!r} not in tcp|pickle")
+        self.tenant = str(tenant)
+        self.transport = transport
+        self.obs_shape = tuple(int(d) for d in obs_shape)
+        self.obs_dtype = np.dtype(obs_dtype)
+        self.timeout_s = float(timeout_s)
+        self.retries = max(1, int(retries))
+        self.resends = 0
+        self.acts = 0
+        self._seq = 0
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.DEALER)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        self._sock.connect(address)
+        self._address = address
+        self.session: str | None = None
+        self.lease_s: float | None = None
+        self.replica: int | None = None
+        self.pinned_version: int | None = None
+        self._attach(session, pin_version, trace)
+
+    def _recv(self, timeout_s: float) -> tuple[str, Any] | None:
+        if not self._sock.poll(int(timeout_s * 1e3)):
+            return None
+        return decode_payload(self._sock.recv())
+
+    def _attach(self, session: str | None, pin_version: int | None,
+                trace: str | None) -> None:
+        hello = encode_hello(
+            self.tenant, session=session, obs_shape=self.obs_shape,
+            obs_dtype=self.obs_dtype.str, transport=self.transport,
+            pin_version=pin_version, trace=trace,
+        )
+        for _ in range(self.retries):
+            self._sock.send(hello)
+            got = self._recv(self.timeout_s)
+            if got is None:
+                continue
+            kind, obj = got
+            if kind == "hello_no":
+                raise GatewayError(obj["reason"])
+            if kind == "hello_ok":
+                self.session = obj["session"]
+                self.lease_s = float(obj["lease_s"])
+                self.replica = int(obj["replica"])
+                self.pinned_version = obj.get("pinned_version")
+                return
+            # stale act reply from a previous incarnation: drain it
+        raise TimeoutError(f"gateway attach timed out against {self._address}")
+
+    def act(self, obs) -> tuple[np.ndarray, dict]:
+        """One act round-trip; returns ``(actions, info)`` where info
+        carries the SERVED param version plus the cached/unpinned flags
+        (a pin abandoned server-side is never silent)."""
+        if self.session is None:
+            raise GatewayError("session is detached")
+        obs = np.ascontiguousarray(obs, self.obs_dtype)
+        self._seq += 1
+        seq = self._seq
+        if self.transport == "pickle":
+            frame = encode_pickle_msg({
+                "kind": "act", "session": self.session, "seq": seq,
+                "obs": obs, "t_send": time.time(),
+            })
+        else:
+            frame = encode_act(self.session, seq, obs, t_send=time.time())
+        per_try = self.timeout_s / self.retries
+        for attempt in range(self.retries):
+            if attempt:
+                self.resends += 1
+            self._sock.send(frame)
+            deadline = time.monotonic() + per_try
+            while True:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                got = self._recv(left)
+                if got is None:
+                    break
+                kind, obj = got
+                if kind == "act_ok" and obj["seq"] == seq:
+                    self.acts += 1
+                    return decode_act_ok(obj)
+                if kind == "act_err" and obj["seq"] in (seq, 0):
+                    raise GatewayError(obj["reason"])
+                # anything else: a stale reply for an old seq — drain
+        raise TimeoutError(
+            f"act seq {seq} got no reply after {self.retries} sends"
+        )
+
+    def detach(self) -> None:
+        if self.session is None:
+            return
+        self._sock.send(encode_detach(self.session))
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
+            got = self._recv(deadline - time.monotonic())
+            if got is not None and got[0] == "detach_ok":
+                break
+        self.session = None
+
+    def close(self) -> None:
+        try:
+            self.detach()
+        except (zmq.ZMQError, TimeoutError):
+            pass  # best-effort: the lease reaper collects silent exits
+        self._sock.close(0)

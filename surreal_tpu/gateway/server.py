@@ -1,0 +1,532 @@
+"""GatewayServer: the tenant-facing session tier in front of the
+:class:`~surreal_tpu.distributed.fleet.InferenceFleet` (ISSUE 12
+tentpole) — attach/act/detach over the gateway wire protocol, admission
+control, migrating session state, version pinning, and the act cache.
+
+Shape: one ROUTER socket at a FIXED address (``utils/net.py``
+``alloc_address`` — the respawn-in-place rule), one serve thread
+supervised under ``utils/respawn.py::RespawnSchedule`` (no fourth
+hand-copied supervisor; the import-hygiene lint bans inline backoff
+arithmetic from this package). Each loop pass:
+
+1. fires the ``gateway.session`` chaos site (``drop_frame`` swallows the
+   next act reply — the client's bounded resend recovers;
+   ``kill_replica`` kills the acting session's bound fleet replica — the
+   heal step below must migrate);
+2. **heals**: any session bound to a replica the fleet no longer lists
+   alive is rebound to a survivor via the SAME rendezvous rule that
+   placed it (``fleet.replica_of``), counted as a migration — clients
+   never notice (invisible failover);
+3. **reaps**: sessions idle past their lease are expired (quota
+   released, pins dropped, counted);
+4. **drains**: per-tenant backpressure queues serve as token buckets
+   refill (oldest first);
+5. serves frames: admission-checked acts route to the session's bound
+   replica via ``fleet.serve_act`` — version-pinned sessions serve from
+   the fleet's held closure for V; a pin whose closure was evicted
+   triggers the counted catch_up path (unpin + current version,
+   F_UNPINNED on the reply — never a silent jump). Served results land
+   in a bounded LRU act cache keyed on (version, obs digest); duplicate
+   observations at the same version skip the forward entirely
+   (hit/miss counted).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+import threading
+import zlib
+from collections import OrderedDict, deque
+
+import numpy as np
+import zmq
+
+from surreal_tpu.gateway import protocol as gw
+from surreal_tpu.gateway.admission import AdmissionController
+from surreal_tpu.gateway.table import SessionRecord, SessionTable
+from surreal_tpu.utils import faults
+from surreal_tpu.utils.net import alloc_address
+from surreal_tpu.utils.respawn import RespawnSchedule
+
+
+class GatewayServer:
+    """Runs the session loop in a background thread.
+
+    Args:
+      fleet: the :class:`InferenceFleet` this gateway fronts (routing,
+        version-aware serving, liveness).
+      bind: fixed service address (default: ``alloc_address()``).
+      max_sessions: global session cap (0 = unbounded).
+      lease_s: idle lease; any frame from a session renews it.
+      tenant_quotas: {tenant: {max_sessions, rate, burst, queue_depth}};
+        the ``default`` entry covers unlisted tenants.
+      act_cache: LRU act-result cache capacity (0 disables).
+      pin_versions: honor per-session version-pin requests.
+      fanout: optional :class:`ParameterFanout` — session pins also hold
+        the pinned version's full frame publisher-side.
+    """
+
+    def __init__(
+        self,
+        fleet,
+        *,
+        bind: str | None = None,
+        max_sessions: int = 256,
+        lease_s: float = 30.0,
+        tenant_quotas: dict | None = None,
+        act_cache: int = 256,
+        pin_versions: bool = True,
+        fanout=None,
+        trace_id: str | None = None,
+        respawn_backoff_s: float = 0.5,
+        respawn_backoff_cap_s: float = 30.0,
+    ):
+        self.fleet = fleet
+        self.address = bind or alloc_address()
+        self.lease_s = float(lease_s)
+        self.pin_versions = bool(pin_versions)
+        self.fanout = fanout
+        self.trace_id = trace_id
+        self.admission = AdmissionController(
+            tenant_quotas, max_sessions_total=int(max_sessions)
+        )
+        self.table = SessionTable()
+        # negotiated per-session obs geometry (raw ACT bodies decode
+        # with it); lives beside the table but is NOT journaled — a
+        # re-attaching client re-negotiates it in the hello
+        self._obs_specs: dict[str, tuple[tuple, np.dtype]] = {}
+        self._cache_cap = int(act_cache)
+        self._cache: "OrderedDict[tuple, tuple[np.ndarray, int]]" = (
+            OrderedDict()
+        )
+        self.attaches = 0
+        self.reattaches = 0
+        self.detaches = 0
+        self.acts = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.catch_ups = 0
+        self.dropped_replies = 0
+        self.respawns = 0
+        self.respawn_backoff_s = 0.0
+        # act round-trip serve time (recv -> reply), rolling window —
+        # the diag/bench latency story server-side
+        self._hop_act: "deque[float]" = deque(maxlen=512)
+        self._drop_next_reply = 0
+        self._last_replica: int | None = None
+        self._sched = RespawnSchedule(
+            1, respawn_backoff_s, respawn_backoff_cap_s
+        )
+        self._lock = threading.Lock()  # supervise vs close
+        self._ctx = zmq.Context.instance()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def supervise(self) -> None:
+        """Respawn a dead serve thread in place (same fixed address, same
+        table — sessions survive their gateway's crash) under the shared
+        backoff schedule."""
+        with self._lock:
+            now = time.monotonic()
+            if self._thread.is_alive():
+                self._sched.note_alive(0, now)
+                return
+            if not self._sched.due(0, now):
+                return
+            self.respawns += 1
+            self.respawn_backoff_s = self._sched.respawned(0, now)
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        if self.fanout is not None:
+            for v, n in self.table.pinned_versions().items():
+                for _ in range(n):
+                    self.fanout.release_pin(v)
+
+    # -- the loop ------------------------------------------------------------
+    def _loop(self) -> None:
+        # bind in the serve thread so a crashed loop's finally releases
+        # the socket and a supervised respawn can rebind the fixed
+        # address (the fleet-replica lifecycle rule)
+        sock = self._ctx.socket(zmq.ROUTER)
+        sock.setsockopt(zmq.ROUTER_HANDOVER, 1)
+        sock.bind(self.address)
+        try:
+            self._loop_body(sock)
+        finally:
+            sock.close(0)
+
+    def _loop_body(self, sock) -> None:
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        while not self._stop.is_set():
+            f = faults.fire("gateway.session")
+            if f is not None:
+                self._apply_fault(f)
+            self._heal(sock)
+            expired = self.table.expire_idle(self.lease_s)
+            if expired:
+                self.admission.note_expired(len(expired))
+                for rec in expired:
+                    self._release_pin(rec)
+                    self._obs_specs.pop(rec.session, None)
+            for tenant in list(self.admission.tenants()):
+                for req in self.admission.drain(tenant):
+                    self._serve_one(sock, req)
+            if dict(poller.poll(timeout=50)).get(sock) is None:
+                continue
+            while True:
+                try:
+                    ident, payload = sock.recv_multipart(zmq.NOBLOCK)
+                except zmq.Again:
+                    break
+                try:
+                    kind, obj = gw.decode_payload(payload)
+                except (ValueError, KeyError, EOFError):
+                    continue  # not ours; never crash the tier on input
+                if kind == "hello":
+                    self._handle_hello(sock, ident, obj)
+                elif kind == "act":
+                    obs = self._act_obs(obj)
+                    if obs is None:
+                        self._reply(sock, ident, gw.encode_act_err(
+                            obj["seq"], "unknown session", obj["session"]
+                        ))
+                        continue
+                    self._admit_act(
+                        sock, (ident, obj["session"], obj["seq"], obs)
+                    )
+                elif kind == "msg" and obj.get("kind") == "act":
+                    # the negotiated pickle fallback request
+                    rec = self.table.get(str(obj.get("session", "")))
+                    if rec is None:
+                        self._reply(sock, ident, gw.encode_act_err(
+                            int(obj.get("seq", 0)), "unknown session",
+                            str(obj.get("session", "")),
+                        ))
+                        continue
+                    self._admit_act(
+                        sock,
+                        (ident, rec.session, int(obj["seq"]),
+                         np.asarray(obj["obs"])),
+                    )
+                elif kind == "detach":
+                    rec = self.table.detach(obj["session"])
+                    if rec is not None:
+                        self.detaches += 1
+                        self._release_pin(rec)
+                        self._obs_specs.pop(rec.session, None)
+                    self._reply(sock, ident, gw.encode_detach_ok(
+                        obj["session"], rec.acts if rec else 0
+                    ))
+
+    def _apply_fault(self, f: dict) -> None:
+        kind = f["kind"]
+        if kind == "delay":
+            faults.sleep_ms(f)
+        elif kind == "drop_frame":
+            # swallow the NEXT act reply on the wire: the tenant's
+            # bounded resend re-serves against the same session/seq
+            self._drop_next_reply += 1
+        elif kind == "kill_replica":
+            # kill the acting session's bound replica, like a crash —
+            # the heal step must migrate its sessions to survivors
+            slot = self._last_replica
+            if slot is None:
+                bound = {r.replica for r in self.table.records()}
+                alive = set(self.fleet._alive_slots())
+                both = sorted(bound & alive)
+                slot = both[0] if both else None
+            if slot is not None:
+                srv = self.fleet._replicas[slot]
+                if srv is not None and srv.alive:
+                    srv.close()
+
+    def _heal(self, sock) -> None:
+        """Rebind sessions whose replica the fleet no longer lists alive
+        (invisible failover: the migration happens between acts)."""
+        alive = set(self.fleet._alive_slots())
+        if not alive:
+            return  # nothing to rebind TO; the fleet supervisor first
+        dead = {
+            r.replica for r in self.table.records()
+        } - alive
+        for slot in dead:
+            self.table.rebind(
+                slot,
+                lambda sid: self.fleet.replica_of(zlib.crc32(sid.encode())),
+            )
+
+    # -- frame handlers ------------------------------------------------------
+    def _reply(self, sock, ident: bytes, payload: bytes) -> None:
+        if self._drop_next_reply > 0 and payload[4:5] == bytes([gw.ACT_OK]):
+            self._drop_next_reply -= 1
+            self.dropped_replies += 1
+            return
+        sock.send_multipart([ident, payload])
+
+    def _handle_hello(self, sock, ident: bytes, obj: dict) -> None:
+        transport = obj.get("transport", "tcp")
+        if transport not in ("tcp", "pickle"):
+            self._reply(sock, ident, gw.encode_hello_no(
+                f"transport {transport!r} not in tcp|pickle"
+            ))
+            return
+        tenant = str(obj.get("tenant", "default"))
+        sid = obj.get("session")
+        if sid:
+            rec = self.table.touch(str(sid))
+            if rec is not None:
+                # re-attach after client churn: the gateway owns the
+                # mapping, so the binding (and any pin) survives
+                self.reattaches += 1
+                self._install_obs_spec(rec.session, obj)
+                self._reply(sock, ident, gw.encode_hello_ok(
+                    rec.session, self.lease_s, rec.transport,
+                    rec.replica, rec.pinned_version,
+                ))
+                return
+        reason = self.admission.admit_session(
+            tenant,
+            self.table.tenant_counts().get(tenant, 0),
+            len(self.table),
+        )
+        if reason is not None:
+            self._reply(sock, ident, gw.encode_hello_no(reason))
+            return
+        pin = obj.get("pin_version")
+        if pin is not None and self.pin_versions:
+            pin = int(pin)
+            if pin not in self.fleet.held_versions():
+                self._reply(sock, ident, gw.encode_hello_no(
+                    f"version {pin} not held "
+                    f"(held: {self.fleet.held_versions()})"
+                ))
+                return
+            if self.fanout is not None:
+                try:
+                    self.fanout.pin_version(pin)
+                except KeyError:
+                    pass  # fleet holds the closure; the frame hold is
+                    #       best-effort for catch-up subscribers
+        else:
+            pin = None
+        sid = gw.new_session_id()
+        replica = self.fleet.replica_of(zlib.crc32(sid.encode()))
+        rec = SessionRecord(
+            sid, tenant, replica, transport=transport, pinned_version=pin
+        )
+        self.table.attach(rec)
+        self.attaches += 1
+        self._install_obs_spec(sid, obj)
+        self._reply(sock, ident, gw.encode_hello_ok(
+            sid, self.lease_s, transport, replica, pin
+        ))
+
+    def _install_obs_spec(self, sid: str, obj: dict) -> None:
+        self._obs_specs[sid] = (
+            tuple(int(d) for d in obj.get("obs_shape", ())),
+            np.dtype(obj.get("obs_dtype", "<f4")),
+        )
+
+    def _act_obs(self, obj: dict) -> np.ndarray | None:
+        spec = self._obs_specs.get(obj["session"])
+        if spec is None:
+            return None
+        shape, dtype = spec
+        return np.frombuffer(obj["body"], dtype).reshape(shape)
+
+    def _admit_act(self, sock, req: tuple) -> None:
+        ident, sid, seq, obs = req
+        rec = self.table.get(sid)
+        if rec is None:
+            self._reply(sock, ident, gw.encode_act_err(
+                seq, "unknown session", sid
+            ))
+            return
+        if self.admission.try_act(rec.tenant):
+            self._serve_one(sock, req)
+            return
+        evicted = self.admission.enqueue(rec.tenant, req)
+        if evicted is not None:
+            ev_ident, ev_sid, ev_seq, _ = evicted
+            self._reply(sock, ev_ident, gw.encode_act_err(
+                ev_seq, "evicted by backpressure (tenant queue full)",
+                ev_sid,
+            ))
+
+    def _serve_one(self, sock, req: tuple) -> None:
+        ident, sid, seq, obs = req
+        rec = self.table.get(sid)
+        if rec is None:
+            self._reply(sock, ident, gw.encode_act_err(
+                seq, "session expired while queued", sid
+            ))
+            return
+        t0 = time.monotonic()
+        flags = 0
+        version_key = (
+            rec.pinned_version if rec.pinned_version is not None
+            else self.fleet.version
+        )
+        digest = None
+        if self._cache_cap > 0:
+            digest = hashlib.blake2b(
+                obs.tobytes() + str((obs.shape, obs.dtype.str)).encode(),
+                digest_size=16,
+            ).digest()
+            hit = self._cache.get((version_key, digest))
+            if hit is not None:
+                self._cache.move_to_end((version_key, digest))
+                self.cache_hits += 1
+                actions, served = hit
+                self._finish_act(sock, ident, rec, seq, actions, served,
+                                 flags | gw.F_CACHED, t0)
+                return
+            self.cache_misses += 1
+        try:
+            actions, served = self.fleet.serve_act(
+                obs, replica=rec.replica, version=rec.pinned_version
+            )
+        except KeyError:
+            # (before LookupError: KeyError IS a LookupError.) the
+            # pinned closure was evicted from the act history: the
+            # counted catch_up path — unpin EXPLICITLY (F_UNPINNED on
+            # the reply) and serve the current version; never a silent
+            # jump
+            self.catch_ups += 1
+            self._release_pin(rec)
+            self.table.pin(sid, None)
+            flags |= gw.F_UNPINNED
+            try:
+                actions, served = self.fleet.serve_act(
+                    obs, replica=rec.replica
+                )
+            except LookupError:
+                self._reply(sock, ident, gw.encode_act_err(
+                    seq, "no alive replica", sid
+                ))
+                return
+        except LookupError:
+            # bound replica died between heal passes: migrate NOW and
+            # serve from the survivor — the tenant never sees it
+            self._heal(sock)
+            rec = self.table.get(sid)
+            if rec is None:
+                return
+            try:
+                actions, served = self.fleet.serve_act(
+                    obs, replica=rec.replica, version=rec.pinned_version
+                )
+            except KeyError:
+                self.catch_ups += 1
+                self._release_pin(rec)
+                self.table.pin(sid, None)
+                flags |= gw.F_UNPINNED
+                try:
+                    actions, served = self.fleet.serve_act(
+                        obs, replica=rec.replica
+                    )
+                except LookupError:
+                    self._reply(sock, ident, gw.encode_act_err(
+                        seq, "no alive replica", sid
+                    ))
+                    return
+            except LookupError:
+                self._reply(sock, ident, gw.encode_act_err(
+                    seq, "no alive replica", sid
+                ))
+                return
+        if self._cache_cap > 0 and digest is not None:
+            self._cache[(served, digest)] = (actions, served)
+            self._cache.move_to_end((served, digest))
+            while len(self._cache) > self._cache_cap:
+                self._cache.popitem(last=False)
+        self._finish_act(sock, ident, rec, seq, actions, served, flags, t0)
+
+    def _finish_act(self, sock, ident, rec, seq, actions, served, flags,
+                    t0) -> None:
+        self.table.touch(rec.session, seq=seq)
+        self.acts += 1
+        self._last_replica = rec.replica
+        self._hop_act.append((time.monotonic() - t0) * 1e3)
+        self._reply(sock, ident, gw.encode_act_ok(
+            seq, served, actions, flags=flags, t_send=time.time()
+        ))
+
+    def _release_pin(self, rec: SessionRecord) -> None:
+        if self.fanout is not None and rec.pinned_version is not None:
+            self.fanout.release_pin(rec.pinned_version)
+
+    # -- observability -------------------------------------------------------
+    def gauges(self) -> dict[str, float]:
+        """The ``gateway/*`` gauge family (GAUGE_REGISTRY documents
+        each)."""
+        out = {
+            "gateway/sessions": float(len(self.table)),
+            "gateway/attaches": float(self.attaches),
+            "gateway/reattaches": float(self.reattaches),
+            "gateway/detaches": float(self.detaches),
+            "gateway/acts": float(self.acts),
+            "gateway/cache_hits": float(self.cache_hits),
+            "gateway/cache_misses": float(self.cache_misses),
+            "gateway/migrations": float(self.table.migrations),
+            "gateway/catch_ups": float(self.catch_ups),
+            "gateway/pinned_sessions": float(
+                sum(self.table.pinned_versions().values())
+            ),
+            "gateway/dropped_replies": float(self.dropped_replies),
+            "gateway/respawns": float(self.respawns),
+        }
+        out.update(self.admission.gauges())
+        return out
+
+    def hop_stats(self) -> dict[str, dict]:
+        from surreal_tpu.session.telemetry import latency_percentiles
+
+        p = latency_percentiles(list(self._hop_act))
+        return {"gateway_act_ms": p} if p is not None else {}
+
+    def tenant_stats(self) -> dict[str, dict]:
+        """Per-tenant table for diag's Gateway section."""
+        counts = self.table.tenant_counts()
+        out: dict[str, dict] = {}
+        for name, t in self.admission.tenants().items():
+            out[name] = {
+                "sessions": counts.get(name, 0),
+                "max_sessions": t.max_sessions,
+                "rate": t.bucket.rate,
+                "queued": len(t.queue),
+                "throttled": t.throttled,
+                "evicted": t.evicted,
+                "rejected": t.rejected,
+            }
+        for name, n in counts.items():
+            if name not in out:
+                out[name] = {"sessions": n}
+        return out
+
+    def event(self) -> dict:
+        """The ``gateway`` telemetry event body (diag's "Gateway"
+        section)."""
+        hits, misses = self.cache_hits, self.cache_misses
+        return {
+            "address": self.address,
+            "tenants": self.tenant_stats(),
+            "pinned_versions": {
+                str(v): n for v, n in self.table.pinned_versions().items()
+            },
+            "cache_hit_rate": hits / max(hits + misses, 1),
+            "lease_s": self.lease_s,
+            **self.gauges(),
+        }

@@ -257,6 +257,13 @@ class SessionHooks:
         "Serving tier" section renders the last one."""
         self.tracer.event("serving_tier", **info)
 
+    def gateway_event(self, **info) -> None:
+        """Record the session gateway's tenant-facing snapshot (sessions,
+        admission counters, cache hit-rate, pinned versions) as one
+        telemetry ``gateway`` event per metrics row — ``surreal_tpu
+        diag``'s "Gateway" section renders the last one."""
+        self.tracer.event("gateway", **info)
+
     def experience_event(self, **info) -> None:
         """Record the experience plane's settled shape (shard transports,
         per-shard fill/ingest, wire bytes/step, sample-wait) as one

@@ -517,6 +517,7 @@ class SEEDTrainer:
         plane = None
         prefetch = None
         xplane = None
+        gateway = None
         stop = threading.Event()
         try:
             state, iteration, env_steps = hooks.restore(state)
@@ -555,6 +556,38 @@ class SEEDTrainer:
             )
             server = plane.server
             self._workers = plane.workers  # exposed for tests/fault injection
+
+            # session gateway (ISSUE 12, gateway/): the tenant-facing
+            # session tier in front of the serving fleet. Opt-in (the
+            # training loop's own workers never route through it) and
+            # fleet-only — it needs version-aware serve_act ingress.
+            topo = self.config.session_config.topology
+            gw_cfg = topo.get("gateway", None)
+            if (
+                gw_cfg is not None
+                and bool(gw_cfg.get("enabled", False))
+                and hasattr(server, "serve_act")
+            ):
+                from surreal_tpu.gateway import GatewayServer
+
+                gateway = GatewayServer(
+                    server,
+                    bind=gw_cfg.get("bind", None),
+                    max_sessions=int(gw_cfg.get("max_sessions", 256)),
+                    lease_s=float(gw_cfg.get("lease_s", 30.0)),
+                    tenant_quotas=gw_cfg.get("tenant_quotas", None),
+                    act_cache=int(gw_cfg.get("act_cache", 256)),
+                    pin_versions=bool(gw_cfg.get("pin_versions", True)),
+                    trace_id=hooks.trace_id,
+                    respawn_backoff_s=float(
+                        gw_cfg.get("respawn_backoff_s", 0.5)
+                    ),
+                    respawn_backoff_cap_s=float(
+                        gw_cfg.get("respawn_backoff_cap_s", 30.0)
+                    ),
+                )
+                self._gateway = gateway  # exposed for tests
+                hooks.log.info("session gateway live at %s", gateway.address)
 
             # experience-plane chunk relay (FIFO arm): a relay thread
             # ships every assembled chunk through the ExperienceSender;
@@ -719,6 +752,8 @@ class SEEDTrainer:
                 iteration += 1
                 env_steps += n_steps
                 plane.supervise()
+                if gateway is not None:
+                    gateway.supervise()
                 if not dp_event_emitted:
                     # negotiated data-plane shape, once the fleet settled
                     # (visible in `surreal_tpu diag` without a metrics row)
@@ -736,6 +771,7 @@ class SEEDTrainer:
                     # cached (last-cadence) plane gauges: the wire poll
                     # happens below at the cadence, not per iteration
                     **(xplane.gauges(poll=False) if xplane is not None else {}),
+                    **(gateway.gauges() if gateway is not None else {}),
                 )
                 m_row, stop_flag = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, metrics, on_metrics
@@ -752,6 +788,8 @@ class SEEDTrainer:
                         # EWMA) + the per-replica telemetry snapshot
                         server.maybe_autoscale()
                         hooks.serving_event(**server.tier_event())
+                    if gateway is not None:
+                        hooks.gateway_event(**gateway.event())
                     if xplane is not None:
                         xplane._poll_stats()
                         hooks.experience_event(**xplane.telemetry_event())
@@ -797,6 +835,10 @@ class SEEDTrainer:
                 xplane._stop.set()
                 relay_thread.join(timeout=5)
                 xplane.close()
+            if gateway is not None:
+                # sessions die with the run; close BEFORE the fleet so the
+                # gateway never serves into torn-down replicas
+                gateway.close()
             if plane is not None:
                 plane.close()
             hooks.close()

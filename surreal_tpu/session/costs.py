@@ -37,8 +37,8 @@ from __future__ import annotations
 
 from typing import Any
 
-# Documented registry of every perf/*, replay/*, and experience/* gauge
-# the codebase may emit.
+# Documented registry of every perf/*, replay/*, experience/*, fleet/*,
+# param/*, and gateway/* gauge the codebase may emit.
 # tests/test_import_hygiene.py::test_perf_gauges_appear_in_registry scans
 # the package source for whole "<prefix>/<name>" literals and fails on
 # any not listed here. Keep descriptions current — diag and README point
@@ -130,6 +130,56 @@ GAUGE_REGISTRY = {
         "ParameterClient.fetch catch-ups this subscriber performed "
         "(the late-joiner / dropped-frame path; counted, never silent)."
     ),
+    "param/holds": (
+        "param versions the fanout currently holds pinned for gateway "
+        "sessions (full frames retained until every pin releases)."
+    ),
+    # -- session gateway (surreal_tpu/gateway/; tenant-facing tier) ---------
+    "gateway/sessions": "sessions currently attached across all tenants.",
+    "gateway/attaches": "sessions admitted this run (first attach only).",
+    "gateway/reattaches": (
+        "re-attaches onto a live session id (client reconnect; the "
+        "session record and its replica binding survive)."
+    ),
+    "gateway/detaches": "explicit tenant detaches this run.",
+    "gateway/acts": "act requests served (cache hits included).",
+    "gateway/cache_hits": (
+        "acts answered from the bounded (version, obs-digest) act cache "
+        "without touching a fleet replica."
+    ),
+    "gateway/cache_misses": "acts that paid a fleet serve_act forward.",
+    "gateway/migrations": (
+        "session rebinds performed after a replica death (invisible "
+        "failover; counted per moved session)."
+    ),
+    "gateway/catch_ups": (
+        "pinned sessions force-unpinned because their param version was "
+        "evicted from the fleet's act history (flagged on the reply — "
+        "counted, never silent)."
+    ),
+    "gateway/pinned_sessions": "sessions currently pinned to a param version.",
+    "gateway/dropped_replies": (
+        "act replies swallowed by fault injection (gateway.session "
+        "drop_frame); the client's bounded resend redelivers."
+    ),
+    "gateway/respawns": (
+        "gateway serve-thread respawns performed by its supervisor "
+        "(in place, fixed address, shared backoff schedule)."
+    ),
+    # admission plane (gateway/admission.py)
+    "gateway/rejected_sessions": (
+        "attach attempts refused by session quota (global or per-tenant)."
+    ),
+    "gateway/throttled_acts": (
+        "acts past a tenant's token-bucket rate, parked in its bounded "
+        "queue instead of served immediately."
+    ),
+    "gateway/evicted_requests": (
+        "oldest queued acts evicted when a tenant's backpressure queue "
+        "overflowed (each gets an ACT_ERR — counted, never silent)."
+    ),
+    "gateway/expired_leases": "sessions reaped idle past their lease.",
+    "gateway/queued_acts": "acts currently parked across tenant queues.",
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

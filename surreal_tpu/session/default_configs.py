@@ -250,6 +250,48 @@ BASE_SESSION_CONFIG = Config(
             scale_cooldown_s=30.0,    # min seconds between decisions
             respawn_backoff_s=0.5,
             respawn_backoff_cap_s=30.0,
+            # bounded {version -> act closure} history kept for the
+            # gateway's version-pinned serves (oldest evicted; an
+            # evicted pin surfaces as a counted gateway catch_up)
+            act_history=8,
+        ),
+        # production session gateway (surreal_tpu/gateway/): the
+        # tenant-facing session tier in front of the inference fleet —
+        # external sessions attach (id + lease), act over the gateway
+        # wire protocol (tcp struct frames; pickle as the negotiated
+        # per-session fallback), and detach. The gateway OWNS the
+        # session->replica mapping (rendezvous-hashed like workers), so
+        # routing survives client churn and replica death (sessions
+        # rebind to survivors from the session table — counted
+        # migrations, invisible to tenants). Admission is per-tenant:
+        # token-bucket act rates, max-session quotas, bounded
+        # backpressure queues (oldest evicted WITH an error reply), and
+        # lease expiry reaping idle sessions. Version pinning serves a
+        # tenant from a held param version while others ride the fanout
+        # head; the act cache short-circuits duplicate observations at
+        # the same version (hit/miss counted).
+        gateway=Config(
+            enabled=False,
+            bind=None,            # fixed service address (None = allocate
+                                  # a loopback port at start)
+            max_sessions=256,     # global cap (0 = unbounded)
+            lease_s=30.0,         # idle lease; any session frame renews
+            act_cache=256,        # LRU act-result entries (0 = off)
+            pin_versions=True,    # honor per-session version pins
+            # per-tenant quotas; the 'default' entry covers tenants not
+            # named here. rate=0 disables the token bucket.
+            tenant_quotas=Config(
+                default=Config(
+                    max_sessions=64,   # sessions per tenant (0 = unbounded)
+                    rate=200.0,        # acts/s refill
+                    burst=400.0,       # bucket depth
+                    queue_depth=64,    # backpressure queue bound
+                ),
+            ),
+            # gateway serve-thread supervision (the shared respawn
+            # schedule — utils/respawn.py)
+            respawn_backoff_s=0.5,
+            respawn_backoff_cap_s=30.0,
         ),
         # host-env (gym/dm_control) loops: collect iteration k+1 on a
         # worker thread while the device learns on k (the reference's

@@ -103,6 +103,14 @@ line, ``t`` = unix seconds):
                      hops; one per metrics row, the last one wins.
                      surreal_tpu/experience/, rendered by diag's
                      "Experience plane" section)
+    {"type": "gateway", "t": ..., "address": "...", "tenants": {"name":
+     {sessions, max_sessions, rate, queued, throttled, evicted,
+     rejected}, ...}, "pinned_versions": {...}, "cache_hit_rate": ...,
+     "gateway/...": ...}
+                    (the session gateway's tenant-facing snapshot —
+                     surreal_tpu/gateway/, one per metrics row while the
+                     gateway is live; rendered by diag's "Gateway"
+                     section)
 
 Every event additionally carries ``trace`` (the run-scoped trace id
 SessionHooks mints and spawned components inherit) and ``seq`` (a
@@ -386,6 +394,7 @@ def diag_summary(folder: str) -> dict | None:
     data_plane = None
     experience = None
     serving = None
+    gateway = None
     trace_id = None
     programs: dict[str, dict] = {}   # program_cost events (last per name)
     precision = None                 # last 'precision' event (active policy)
@@ -443,6 +452,12 @@ def diag_summary(folder: str) -> dict | None:
             # the last event is the settled plane shape (one per metrics
             # row while a sharded experience plane is active)
             experience = {
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "gateway":
+            # the last event is the settled tenant picture (one per
+            # metrics row while the session gateway is live)
+            gateway = {
                 k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
         elif ev.get("type") == "tune":
@@ -555,6 +570,7 @@ def diag_summary(folder: str) -> dict | None:
         "data_plane": data_plane,
         "experience": experience,
         "serving": serving,
+        "gateway": gateway,
         "tune": tune,
         "tune_hits": tune_hits,
         "tune_misses": tune_misses,
@@ -636,6 +652,9 @@ def diag_report(folder: str) -> str | None:
     xp_lines = _experience_plane_lines(s)
     if xp_lines:
         lines += ["", "Experience plane"] + xp_lines
+    gw_lines = _gateway_lines(s)
+    if gw_lines:
+        lines += ["", "Gateway"] + gw_lines
     tn = s.get("tune")
     if tn is not None:
         cfg = tn.get("config") or {}
@@ -826,6 +845,63 @@ def _experience_plane_lines(s: dict) -> list[str]:
             + " | sampler: "
             + ", ".join(f"{k}={smp[k]:g}" for k in sorted(smp))
         )
+    return lines
+
+
+def _gateway_lines(s: dict) -> list[str]:
+    """The diag 'Gateway' section: session/act totals, act-cache hit
+    rate, migration/catch-up counters, pinned-version census, and the
+    per-tenant admission table from the last ``gateway`` event. Empty
+    list when the session ran no gateway."""
+    gw = s.get("gateway")
+    if not gw:
+        return []
+    acts = float(gw.get("gateway/acts", 0))
+    lines = [
+        "  {n:g} session(s) live at {a} — attaches {at:g} "
+        "(+{re:g} re-attach), detaches {d:g}, expired {ex:g}".format(
+            n=float(gw.get("gateway/sessions", 0)),
+            a=gw.get("address", "?"),
+            at=float(gw.get("gateway/attaches", 0)),
+            re=float(gw.get("gateway/reattaches", 0)),
+            d=float(gw.get("gateway/detaches", 0)),
+            ex=float(gw.get("gateway/expired_leases", 0)),
+        ),
+        "  {ac:g} acts, cache hit-rate {hr:.0%} ({h:g} hits / {m:g} "
+        "misses), migrations {mi:g}, catch-ups {cu:g}".format(
+            ac=acts,
+            hr=float(gw.get("cache_hit_rate", 0.0)),
+            h=float(gw.get("gateway/cache_hits", 0)),
+            m=float(gw.get("gateway/cache_misses", 0)),
+            mi=float(gw.get("gateway/migrations", 0)),
+            cu=float(gw.get("gateway/catch_ups", 0)),
+        ),
+    ]
+    pins = gw.get("pinned_versions") or {}
+    if pins:
+        lines.append(
+            "  pinned versions: "
+            + ", ".join(
+                f"v{v}×{pins[v]}" for v in sorted(pins, key=lambda x: int(x))
+            )
+        )
+    tenants = gw.get("tenants") or {}
+    if tenants:
+        lines.append(
+            f"  {'tenant':<12} {'sessions':>9} {'quota':>6} {'queued':>7} "
+            f"{'throttled':>10} {'evicted':>8} {'rejected':>9}"
+        )
+        for name in sorted(tenants):
+            t = tenants[name]
+            quota = int(t.get("max_sessions", 0))
+            lines.append(
+                f"  {name:<12} {int(t.get('sessions', 0)):>9} "
+                + (f"{quota:>6}" if quota else f"{'inf':>6}")
+                + f" {int(t.get('queued', 0)):>7} "
+                f"{int(t.get('throttled', 0)):>10} "
+                f"{int(t.get('evicted', 0)):>8} "
+                f"{int(t.get('rejected', 0)):>9}"
+            )
     return lines
 
 

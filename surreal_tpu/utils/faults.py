@@ -74,6 +74,15 @@ Sites and the kinds they honor:
                          that sees the gap first falls back to
                          ``ParameterClient.fetch`` — counted, never
                          silent)
+    gateway.session      once per gateway serve-loop pass
+                         (``drop_frame``: swallow the act reply frame —
+                         the client's bounded resend redelivers against
+                         the same session/seq, idempotently;
+                         ``kill_replica``: kill the acting session's
+                         bound fleet replica — the gateway must rebind
+                         every session the corpse held to survivors
+                         from the session table, counted as
+                         migrations; ``delay``: sleep ``ms``)
 
 Config wiring: ``session_config.faults.plan`` (a list of spec dicts, or a
 JSON string of one for ``--set`` CLI overrides). Drivers call
@@ -113,6 +122,7 @@ SITES = frozenset(
         "experience.send",
         "fleet.replica",
         "param.publish",
+        "gateway.session",
     }
 )
 

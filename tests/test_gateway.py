@@ -1,0 +1,529 @@
+"""Production session gateway (ISSUE 12, surreal_tpu/gateway/): the
+tenant-facing session tier — attach/act/detach over both transports,
+admission control (quota rejections, backpressure evictions — counted,
+never silent), lease expiry, version pinning with the counted catch_up
+path, the journaled session table, and the chaos done-bar: replica death
+with live sessions migrates every one of them to survivors (invisible
+failover), with no fd or /dev/shm residue over repeated cycles."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import zmq
+
+from surreal_tpu.distributed.fleet import InferenceFleet
+from surreal_tpu.gateway import GatewayError, GatewaySession, GatewayServer
+from surreal_tpu.gateway import protocol as gw
+from surreal_tpu.gateway.table import SessionRecord, SessionTable
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+from surreal_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    faults.configure(None)  # never leak a plan into the next test
+
+
+def _act_fn(obs):
+    b = obs.shape[0]
+    return (
+        np.random.randint(0, 2, size=b),
+        {"logp": np.full(b, -np.log(2), np.float32)},
+    )
+
+
+def _versioned_act_fn(v):
+    """An act closure whose output names the version that served it —
+    the pinning tests read the action values as the served-version
+    witness (independent of the reply header)."""
+    def fn(obs):
+        b = obs.shape[0]
+        return np.full(b, v, np.int64), {}
+    return fn
+
+
+def _gateway(fleet, **kw):
+    kw.setdefault("lease_s", 30.0)
+    return GatewayServer(fleet, **kw)
+
+
+def test_gateway_attach_act_detach_roundtrip_both_transports():
+    """The protocol round-trip on both arms: a tcp session acts through
+    raw struct frames, a pickle session through the negotiated fallback;
+    a duplicate observation at the same version hits the act cache
+    (flagged + counted, strictly no fleet forward); detach frees the
+    session and the counters tell the whole story."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        obs = np.arange(8, dtype=np.float32).reshape(2, 4)
+        s1 = GatewaySession(
+            server.address, tenant="alpha", obs_shape=(2, 4)
+        )
+        assert len(s1.session) == gw.SID_BYTES
+        assert s1.lease_s == pytest.approx(30.0)
+        a1, info1 = s1.act(obs)
+        assert a1.shape == (2,)
+        assert info1["cached"] is False and info1["unpinned"] is False
+        # same obs, same version -> the cache answers (no second forward)
+        a2, info2 = s1.act(obs)
+        assert info2["cached"] is True
+        np.testing.assert_array_equal(a1, a2)
+        # pickle fallback: whole-dict frames in, struct replies out
+        s2 = GatewaySession(
+            server.address, tenant="beta", obs_shape=(2, 4),
+            transport="pickle",
+        )
+        a3, info3 = s2.act(obs * 3)
+        assert a3.shape == (2,)
+        assert info3["param_version"] == fleet.version
+        assert server.gauges()["gateway/sessions"] == 2.0
+        # per-act server-side latency is on the record for diag/bench
+        assert server.hop_stats()["gateway_act_ms"]["p50"] >= 0.0
+        stats = server.tenant_stats()
+        assert stats["alpha"]["sessions"] == 1
+        assert stats["beta"]["sessions"] == 1
+        s1.close()
+        s2.close()
+        for _ in range(100):
+            if server.gauges()["gateway/sessions"] == 0.0:
+                break
+            time.sleep(0.02)
+        g = server.gauges()
+        assert g["gateway/sessions"] == 0.0
+        assert g["gateway/attaches"] == 2.0
+        assert g["gateway/detaches"] == 2.0
+        assert g["gateway/acts"] == 3.0
+        assert g["gateway/cache_hits"] == 1.0
+        assert g["gateway/cache_misses"] == 2.0
+        ev = server.event()
+        assert ev["cache_hit_rate"] == pytest.approx(1 / 3)
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_reattach_keeps_binding_and_quota():
+    """Client churn is not session churn: re-attaching with the granted
+    session id lands on the SAME record (binding, pin, quota slot) —
+    counted as a re-attach, not an attach."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        s1 = GatewaySession(server.address, obs_shape=(1, 4))
+        sid, replica = s1.session, s1.replica
+        s1._sock.close(0)  # vanish without detaching (no lease reap yet)
+        s2 = GatewaySession(
+            server.address, session=sid, obs_shape=(1, 4)
+        )
+        assert s2.session == sid and s2.replica == replica
+        assert server.reattaches == 1 and server.attaches == 1
+        assert server.gauges()["gateway/sessions"] == 1.0
+        s2.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_quota_rejection_and_backpressure_eviction_counted():
+    """Admission is counted, never silent: the quota-exceeded attach gets
+    a reasoned GHELLO_NO (GatewayError), a burst past the token bucket
+    parks in the bounded tenant queue, and overflow evicts the OLDEST
+    queued act with an ACT_ERR reply — every path lands in a gauge."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(
+        fleet,
+        tenant_quotas={
+            "default": {
+                "max_sessions": 1, "rate": 0.5, "burst": 1,
+                "queue_depth": 2,
+            }
+        },
+    )
+    try:
+        sess = GatewaySession(server.address, obs_shape=(1, 2))
+        with pytest.raises(GatewayError, match="session quota"):
+            GatewaySession(server.address, obs_shape=(1, 2))
+        assert server.gauges()["gateway/rejected_sessions"] == 1.0
+        # fire 4 raw acts back-to-back (no reply waits): the burst token
+        # covers #1; #2/#3 park; #4 overflows -> #2 evicted with ACT_ERR
+        obs = np.zeros((1, 2), np.float32)
+        for seq in range(1, 5):
+            sess._sock.send(
+                gw.encode_act(sess.session, seq, obs + seq)
+            )
+        got: dict[int, str] = {}
+        deadline = time.monotonic() + 10
+        while len(got) < 4 and time.monotonic() < deadline:
+            if not sess._sock.poll(1000):
+                continue
+            kind, obj = gw.decode_payload(sess._sock.recv())
+            got[int(obj["seq"])] = kind
+        assert got[1] == "act_ok"
+        assert got[2] == "act_err"          # evicted by backpressure
+        assert got[3] == "act_ok" and got[4] == "act_ok"  # drained
+        g = server.gauges()
+        assert g["gateway/throttled_acts"] >= 3.0
+        assert g["gateway/evicted_requests"] == 1.0
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_lease_expiry_reaps_silent_sessions():
+    """A tenant that vanishes without detaching is reaped once its lease
+    lapses (quota released, counted) — and its next act is a reasoned
+    error, not a resurrection."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet, lease_s=0.3)
+    try:
+        sess = GatewaySession(server.address, obs_shape=(1, 2))
+        sess.act(np.zeros((1, 2), np.float32))
+        deadline = time.monotonic() + 10
+        while len(server.table) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        g = server.gauges()
+        assert g["gateway/sessions"] == 0.0
+        assert g["gateway/expired_leases"] == 1.0
+        with pytest.raises(GatewayError, match="unknown session"):
+            sess.act(np.zeros((1, 2), np.float32))
+        sess._sock.close(0)
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_version_pinning_and_counted_catch_up():
+    """The pinning contract: tenant A pinned at V keeps getting V-served
+    acts while tenant B rides the fleet to V+1 (the action VALUES prove
+    which closure served); when V is evicted from the fleet's act
+    history, A's next act is the counted catch_up — unpinned EXPLICITLY
+    (F_UNPINNED on the reply), served at the current version, never a
+    silent jump."""
+    fleet = InferenceFleet(
+        _versioned_act_fn(0), num_workers=2, replicas=2, unroll_length=4,
+        act_history=2,
+    )
+    server = _gateway(fleet)
+    try:
+        fleet.set_act_fn(_versioned_act_fn(1))  # fleet now at version 1
+        assert 0 in fleet.held_versions()
+        pinned = GatewaySession(
+            server.address, tenant="pinned", obs_shape=(1, 3),
+            pin_version=0,
+        )
+        assert pinned.pinned_version == 0
+        fresh = GatewaySession(
+            server.address, tenant="fresh", obs_shape=(1, 3)
+        )
+        obs = np.ones((1, 3), np.float32)
+        a_pin, info_pin = pinned.act(obs)
+        assert info_pin["param_version"] == 0
+        assert a_pin[0] == 0  # served by the HELD v0 closure
+        a_new, info_new = fresh.act(obs * 2)
+        assert info_new["param_version"] == 1
+        assert a_new[0] == 1
+        assert server.gauges()["gateway/pinned_sessions"] == 1.0
+        # pinning an unheld version is a reasoned rejection up front
+        with pytest.raises(GatewayError, match="not held"):
+            GatewaySession(
+                server.address, obs_shape=(1, 3), pin_version=99
+            )
+        # ride the fleet past the history bound: v0's closure evicts
+        fleet.set_act_fn(_versioned_act_fn(2))
+        fleet.set_act_fn(_versioned_act_fn(3))
+        assert 0 not in fleet.held_versions()
+        a_cu, info_cu = pinned.act(obs * 5)
+        assert info_cu["unpinned"] is True        # never silent
+        assert info_cu["param_version"] == fleet.version
+        assert a_cu[0] == 3
+        g = server.gauges()
+        assert g["gateway/catch_ups"] == 1.0
+        assert g["gateway/pinned_sessions"] == 0.0
+        pinned.close()
+        fresh.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_act_cache_is_version_keyed_and_bounded():
+    """The act cache keys on (served version, obs digest): the same obs
+    after a version bump is a MISS (fresh policy, fresh act), and the
+    LRU bound evicts oldest entries instead of growing."""
+    fleet = InferenceFleet(
+        _versioned_act_fn(0), num_workers=2, replicas=2, unroll_length=4
+    )
+    server = _gateway(fleet, act_cache=4)
+    try:
+        sess = GatewaySession(server.address, obs_shape=(1, 2))
+        obs = np.full((1, 2), 7, np.float32)
+        a0, _ = sess.act(obs)
+        _, info = sess.act(obs)
+        assert info["cached"] is True
+        fleet.set_act_fn(_versioned_act_fn(1))
+        a1, info = sess.act(obs)
+        assert info["cached"] is False  # version bumped: same obs re-acts
+        assert a1[0] == 1 and a0[0] == 0
+        for i in range(8):  # roll the tiny LRU over its bound
+            sess.act(np.full((1, 2), 100 + i, np.float32))
+        assert len(server._cache) <= 4
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_session_table_journal_replays_and_self_compacts():
+    """The migrating-state contract: every mutation cuts one wire frame,
+    replaying the journal reconstructs the live table exactly (bindings,
+    pins, rebinds, detaches — across a real codec round-trip), and the
+    journal self-compacts to stay bounded by the session POPULATION
+    while sessions churn."""
+    table = SessionTable()
+    for i in range(4):
+        table.attach(SessionRecord(f"sid{i:012d}epog", "acme", i % 2))
+    table.pin("sid000000000000epog", 5)
+    table.rebind(1, lambda sid: 0)
+    table.detach("sid000000000003epog")
+    # frames survive a byte round-trip (any wire that moves bytes)
+    frames = [bytes(f) for f in table.journal()]
+    replayed = SessionTable.replay(frames)
+    assert {r.session for r in replayed.records()} == {
+        r.session for r in table.records()
+    }
+    for rec in table.records():
+        twin = replayed.get(rec.session)
+        assert twin.replica == rec.replica
+        assert twin.tenant == rec.tenant
+        assert twin.pinned_version == rec.pinned_version
+    assert all(r.replica == 0 for r in replayed.records())
+    # churn: attach/detach cycles must not grow the journal unboundedly
+    for i in range(300):
+        sid = f"churn{i:08d}epog"[:16]
+        table.attach(SessionRecord(sid, "acme", 0))
+        table.detach(sid)
+    assert len(table.journal()) <= max(
+        SessionTable._COMPACT_FACTOR * len(table.records()), 64
+    ) + 1
+    with pytest.raises(ValueError, match="not a journal frame"):
+        SessionTable.replay([gw.encode_detach("x")])
+
+
+def test_gateway_chaos_drop_frame_client_resend_recovers():
+    """Chaos `gateway.session drop_frame`: the gateway swallows an act
+    reply (counted); the tenant's bounded resend re-serves the same
+    session/seq and the act COMPLETES — delivery the tenant can't tell
+    from a clean round-trip."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        sess = GatewaySession(
+            server.address, obs_shape=(1, 2), timeout_s=4.0, retries=4
+        )
+        faults.configure([
+            {"site": "gateway.session", "kind": "drop_frame", "at": 0},
+        ])
+        for _ in range(100):  # the site fires on the next idle loop pass
+            if faults.get().drain_fired():
+                break
+            time.sleep(0.02)
+        actions, info = sess.act(np.zeros((1, 2), np.float32))
+        assert actions.shape == (1,)
+        assert sess.resends >= 1
+        assert server.gauges()["gateway/dropped_replies"] == 1.0
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_chaos_kill_replica_migrates_every_session():
+    """The chaos done-bar: kill a replica with LIVE sessions bound to it
+    — every session migrates to a survivor (counted), every in-flight
+    tenant's next act succeeds (zero lost sessions, invisible failover),
+    and three kill/respawn cycles leave no fd or /dev/shm residue."""
+    assert not glob.glob("/dev/shm/surreal_dp_*")
+    fd_counts = []
+    for cycle in range(3):
+        fleet = InferenceFleet(
+            _act_fn, num_workers=4, replicas=2, unroll_length=4,
+            respawn_backoff_s=0.01,
+        )
+        server = _gateway(fleet)
+        try:
+            # attach until BOTH replicas carry sessions (rendezvous over
+            # random ids — a handful of attaches covers 2 replicas)
+            sessions = []
+            for i in range(24):
+                sessions.append(GatewaySession(
+                    server.address, tenant=f"t{i % 2}", obs_shape=(1, 3),
+                    timeout_s=6.0, retries=4,
+                ))
+                if len(sessions) >= 4 and {
+                    server.table.get(s.session).replica for s in sessions
+                } == {0, 1}:
+                    break
+            obs = np.zeros((1, 3), np.float32)
+            for i, s in enumerate(sessions):
+                s.act(obs + i)
+            bound = {s.session: server.table.get(s.session).replica
+                     for s in sessions}
+            assert set(bound.values()) == {0, 1}, (
+                "rendezvous left a replica empty after 24 attaches"
+            )
+            faults.configure([
+                {"site": "gateway.session", "kind": "kill_replica", "at": 0},
+            ])
+            deadline = time.monotonic() + 10
+            while (
+                len(fleet._alive_slots()) == 2
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+            assert len(fleet._alive_slots()) == 1, "kill never fired"
+            faults.configure(None)
+            (survivor,) = fleet._alive_slots()
+            victim = 1 - survivor
+            n_victims = sum(1 for r in bound.values() if r == victim)
+            assert n_victims >= 1
+            # zero lost sessions: every tenant's next act serves (the
+            # gateway heals the binding; clients never see the death)
+            for i, s in enumerate(sessions):
+                actions, _ = s.act(obs + 10 + i)
+                assert actions.shape == (1,)
+            assert server.table.migrations >= n_victims
+            for s in sessions:
+                rec = server.table.get(s.session)
+                assert rec.replica == survivor
+            assert server.gauges()["gateway/migrations"] >= n_victims
+            # the fleet supervisor respawns the corpse in place; new
+            # sessions can bind to it again
+            time.sleep(0.05)
+            fleet.supervise()
+            assert len(fleet._alive_slots()) == 2
+            for s in sessions:
+                s.close()
+        finally:
+            faults.configure(None)
+            server.close()
+            fleet.close()
+        fd_counts.append(len(os.listdir("/proc/self/fd")))
+    assert fd_counts[2] <= fd_counts[0] + 2, fd_counts
+    assert not glob.glob("/dev/shm/surreal_dp_*"), "gateway cycles leaked shm"
+
+
+def test_gateway_supervise_respawns_serve_thread_in_place():
+    """The gateway's own lifecycle rides the SHARED RespawnSchedule: a
+    dead serve thread respawns in place (same fixed address, same table
+    — sessions survive their gateway's crash)."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet, respawn_backoff_s=0.01)
+    try:
+        sess = GatewaySession(server.address, obs_shape=(1, 2))
+        sess.act(np.zeros((1, 2), np.float32))
+        # crash the serve thread (not close(): the table must survive)
+        server._stop.set()
+        server._thread.join(timeout=5)
+        assert not server.alive
+        server._stop.clear()
+        time.sleep(0.02)
+        server.supervise()
+        assert server.alive and server.respawns == 1
+        assert server.respawn_backoff_s == pytest.approx(0.01)
+        # the surviving table still serves the SAME session id
+        actions, _ = sess.act(np.ones((1, 2), np.float32))
+        assert actions.shape == (1,)
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_gateway_rides_training_run_end_to_end(tmp_path):
+    """E2E: a SEED training run with the gateway enabled serves external
+    tenant sessions WHILE training (version bumps every learn), emits
+    gateway gauges on the metrics rows and `gateway` telemetry events,
+    and tears down clean."""
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder=str(tmp_path),
+            total_env_steps=600,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            topology=Config(
+                num_env_workers=2,
+                inference_fleet=Config(replicas=2),
+                gateway=Config(enabled=True, lease_s=10.0),
+            ),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg)
+    tenant_acts = []
+    stop = threading.Event()
+
+    def tenant_loop():
+        # an external tenant attaches mid-run and acts on the LIVE policy
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            gateway = getattr(trainer, "_gateway", None)
+            if gateway is not None:
+                break
+            time.sleep(0.1)
+        else:
+            return
+        sess = GatewaySession(
+            gateway.address, tenant="external", obs_shape=(1, 4),
+            timeout_s=10.0, retries=3,
+        )
+        while not stop.is_set():
+            try:
+                actions, info = sess.act(
+                    np.random.rand(1, 4).astype(np.float32)
+                )
+            except (TimeoutError, GatewayError):
+                break
+            tenant_acts.append(int(info["param_version"]))
+            time.sleep(0.05)
+        try:
+            sess.close()
+        except zmq.ZMQError:
+            pass
+
+    t = threading.Thread(target=tenant_loop, daemon=True)
+    t.start()
+    try:
+        state, metrics = trainer.run()
+    finally:
+        stop.set()
+        t.join(timeout=15)
+    assert metrics["time/env_steps"] >= 600
+    assert tenant_acts, "the external tenant never got an act served"
+    assert metrics["gateway/acts"] >= 1.0
+    assert metrics["gateway/sessions"] >= 0.0
+    # the tenant rode the training policy: versions advanced under it
+    assert max(tenant_acts) > 0
+    events = []
+    with open(os.path.join(str(tmp_path), "telemetry", "events.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                events.append(json.loads(line))
+    gw_events = [e for e in events if e.get("type") == "gateway"]
+    assert gw_events, "no gateway telemetry event emitted"
+    last = gw_events[-1]
+    assert "external" in (last.get("tenants") or {})
+    assert not glob.glob("/dev/shm/surreal_dp_*")

@@ -286,6 +286,12 @@ _DATA_PLANE_STEADY_STATE = (
     "distributed/param_fanout.py",
     "experience/plane.py",
     "launch/offpolicy_trainer.py",
+    # the session gateway (ISSUE 12): the tenant protocol's negotiated
+    # pickle fallback lives in gateway/protocol.py (the codec); the
+    # server loop, admission book, and session table never unpickle
+    "gateway/server.py",
+    "gateway/admission.py",
+    "gateway/table.py",
 )
 
 
@@ -294,9 +300,9 @@ def test_data_plane_pickles_only_in_fallback_codec():
     extended over the experience plane): ``pickle.dumps``/``pickle.loads``
     of ndarray payloads may appear only in the fallback transport modules
     and control-frame codecs (``distributed/shm_transport.py``,
-    ``experience/wire.py``) — never in the steady-state serve/step loops,
-    which must route every encode/decode through the codec so the
-    transport decision stays in one place."""
+    ``experience/wire.py``, ``gateway/protocol.py``) — never in the
+    steady-state serve/step loops, which must route every encode/decode
+    through the codec so the transport decision stays in one place."""
     banned = ("pickle.dumps(", "pickle.loads(", "import pickle")
     bad = []
     for rel in _DATA_PLANE_STEADY_STATE:
@@ -306,18 +312,22 @@ def test_data_plane_pickles_only_in_fallback_codec():
                 bad.append(f"{rel}: {b}")
     assert not bad, (
         "ndarray pickling belongs to the fallback codecs "
-        "(distributed/shm_transport.py, experience/wire.py), not the "
-        "steady-state data-plane loops:\n"
+        "(distributed/shm_transport.py, experience/wire.py, "
+        "gateway/protocol.py), not the steady-state data-plane loops:\n"
         + "\n".join(bad)
     )
-    for codec_rel in ("distributed/shm_transport.py", "experience/wire.py"):
+    for codec_rel in (
+        "distributed/shm_transport.py",
+        "experience/wire.py",
+        "gateway/protocol.py",
+    ):
         codec = (_PKG_ROOT / codec_rel).read_text()
         assert "pickle.dumps(" in codec and "pickle.loads(" in codec, (
             f"the fallback codec moved out of {codec_rel}; update this lint"
         )
 
 
-_SUPERVISED_PACKAGES = ("distributed", "launch")
+_SUPERVISED_PACKAGES = ("distributed", "launch", "gateway")
 
 
 def test_no_swallowed_exceptions_in_supervised_code():
@@ -352,9 +362,10 @@ def test_no_swallowed_exceptions_in_supervised_code():
 
 def test_perf_gauges_appear_in_registry():
     """Gauge-registry lint (ISSUE 6 satellite, extended by ISSUE 8 over
-    the replay/experience families and ISSUE 10 over the serving-tier
-    fleet/param families): every ``perf/*``, ``replay/*``,
-    ``experience/*``, ``fleet/*``, or ``param/*`` gauge name emitted
+    the replay/experience families, ISSUE 10 over the serving-tier
+    fleet/param families, and ISSUE 12 over the gateway family): every
+    ``perf/*``, ``replay/*``, ``experience/*``, ``fleet/*``,
+    ``param/*``, or ``gateway/*`` gauge name emitted
     anywhere in the package must appear in the documented registry
     (``session/costs.py::GAUGE_REGISTRY``) — an undocumented gauge is
     invisible to diag readers and to the README's knob table. The scan
@@ -366,7 +377,7 @@ def test_perf_gauges_appear_in_registry():
     from surreal_tpu.session.costs import GAUGE_REGISTRY
 
     lit = re.compile(
-        r"[\"']((?:perf|replay|experience|fleet|param)/[a-z0-9_]+)[\"']"
+        r"[\"']((?:perf|replay|experience|fleet|param|gateway)/[a-z0-9_]+)[\"']"
     )
     bad = []
     for path in sorted(_PKG_ROOT.rglob("*.py")):
@@ -380,14 +391,43 @@ def test_perf_gauges_appear_in_registry():
                     f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
                 )
     assert not bad, (
-        "perf/replay/experience/fleet/param gauges emitted but not "
+        "perf/replay/experience/fleet/param/gateway gauges emitted but not "
         "documented in session/costs.py::GAUGE_REGISTRY:\n" + "\n".join(bad)
     )
     # and the registry names must parse as gauge literals themselves
     for name in GAUGE_REGISTRY:
         assert name.startswith(
-            ("perf/", "replay/", "experience/", "fleet/", "param/")
+            ("perf/", "replay/", "experience/", "fleet/", "param/",
+             "gateway/")
         ), name
+
+
+def test_gateway_reuses_shared_supervision_utilities():
+    """Supervisor-reuse lint (ISSUE 12 satellite): the gateway must NOT
+    hand-copy a fourth respawn supervisor — backoff arithmetic lives in
+    ``utils/respawn.py::RespawnSchedule`` (the fleet's, the worker
+    plane's, and the experience plane's shared schedule) and port
+    allocation in ``utils/net.py::alloc_address``. The scan bans the
+    exponential-backoff idiom (``2 **`` / ``2.0 **``) anywhere under
+    ``gateway/`` and asserts the server imports both shared utilities."""
+    bad = []
+    for path in sorted((_PKG_ROOT / "gateway").rglob("*.py")):
+        src = path.read_text()
+        for needle in ("2 **", "2.0 **", "2**", "2.0**"):
+            if needle in src:
+                bad.append(f"{path.relative_to(_REPO_ROOT)}: {needle!r}")
+    assert not bad, (
+        "inline exponential-backoff arithmetic in gateway/ (use "
+        "utils/respawn.py::RespawnSchedule — one backoff policy, "
+        "one implementation):\n" + "\n".join(bad)
+    )
+    server_src = (_PKG_ROOT / "gateway" / "server.py").read_text()
+    assert "RespawnSchedule" in server_src, (
+        "gateway/server.py no longer uses utils/respawn.py::RespawnSchedule"
+    )
+    assert "alloc_address" in server_src, (
+        "gateway/server.py no longer uses utils/net.py::alloc_address"
+    )
 
 
 def test_graft_entry_import_initializes_no_backend():
