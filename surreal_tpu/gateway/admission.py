@@ -127,6 +127,13 @@ class AdmissionController:
             )
         return None
 
+    def note_rejected(self, name: str) -> None:
+        """Count an attach denial decided OUTSIDE the quota arithmetic
+        (e.g. the server's resume-credential check) — same counters as a
+        quota rejection, so no denial is silent."""
+        self.rejected_sessions += 1
+        self.tenant(name).rejected += 1
+
     # -- act rate limiting + backpressure ------------------------------------
     def try_act(self, name: str) -> bool:
         """One token for one act; False = throttle (enqueue the request)."""

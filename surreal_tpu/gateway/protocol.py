@@ -2,23 +2,30 @@
 (ISSUE 12 tentpole, piece 1).
 
 The PR-8 experience wire promoted to a PUBLIC attach/detach protocol: the
-hello handshake becomes a session attach (id + lease), act request/reply
-frames become length-framed structs, and the same one-sniff routing rule
-applies — MAGIC-prefixed control/struct frames for the tcp arm, whole
-pickled dicts for the negotiated per-session fallback. ``pickle.dumps``/
+hello handshake becomes a session attach (id + lease + resume token), act
+request/reply frames become length-framed structs, and EVERY frame — the
+negotiated pickle fallback included — is MAGIC-prefixed. The fallback
+wraps its pickled dict in a **PMSG** envelope that carries the session id
+in the clear, so the server can check the session actually negotiated
+``transport='pickle'`` BEFORE any unpickling happens: a tenant-facing
+socket must never ``pickle.loads`` bytes it has not tied to a session
+that asked for them (arbitrary-code-execution otherwise). ``pickle.dumps``/
 ``loads`` of payload data live ONLY in this module (the
 ``experience/wire.py`` discipline; ``tests/test_import_hygiene.py`` lints
-the other ``surreal_tpu/gateway/`` modules for it).
+the other ``surreal_tpu/gateway/`` modules for it), and the loads half is
+:func:`decode_pickle_body` — called by the server only after the
+session/transport gate.
 
 Frames (single ZMQ frames after the DEALER ident):
 
-- **GHELLO** (JSON): tenant, optional session id (re-attach after client
-  churn — the gateway OWNS the session table, so the binding survives),
-  obs geometry (shape/dtype — negotiated once, so steady-state ACT frames
-  carry raw bytes with no per-frame metadata), transport, optional
-  version pin, trace id.
-- **GHELLO_OK / GHELLO_NO** (JSON): granted session id + lease, or the
-  counted rejection reason (quota, capacity).
+- **GHELLO** (JSON): tenant, optional session id + resume token
+  (re-attach after client churn — the gateway OWNS the session table, so
+  the binding survives; the token proves the resumer is the tenant the
+  session was granted to), obs geometry (shape/dtype — negotiated once,
+  so steady-state ACT frames carry raw bytes with no per-frame
+  metadata), transport, optional version pin, trace id.
+- **GHELLO_OK / GHELLO_NO** (JSON): granted session id + lease + resume
+  token, or the counted rejection reason (quota, capacity).
 - **ACT**: struct header (session id, seq, flags, t_send) + raw obs
   bytes. ``seq`` makes the bounded client resend idempotent-enough: a
   reply lost to chaos (``gateway.session`` ``drop_frame``) is simply
@@ -33,6 +40,10 @@ Frames (single ZMQ frames after the DEALER ident):
 - **DETACH / DETACH_OK** (JSON).
 - **JOURNAL** (JSON): one session-table mutation, the incremental
   checkpoint frame ``gateway/table.py`` ships over the experience wire.
+- **PMSG**: session id (fixed width) + pickled request dict — the
+  negotiated fallback's act request. The id rides OUTSIDE the pickle so
+  the server can gate deserialization on the session's negotiated
+  transport.
 
 Any frame from a session renews its lease (``gateway/admission.py``
 reaps the idle).
@@ -60,6 +71,7 @@ ACT_ERR = 6
 DETACH = 7
 DETACH_OK = 8
 JOURNAL = 9
+PMSG = 10
 
 # session ids are fixed-width (uuid4 hex prefix) so the ACT header stays
 # a fixed struct — no per-frame length fields on the hot path
@@ -77,7 +89,15 @@ def new_session_id() -> str:
     return uuid.uuid4().hex[:SID_BYTES]
 
 
+def new_resume_token() -> str:
+    """The re-attach credential granted alongside a session id: the id
+    routes, the token authenticates — a client that merely learns (or
+    guesses) another tenant's session id cannot resume it."""
+    return uuid.uuid4().hex
+
+
 def encode_hello(tenant: str, *, session: str | None = None,
+                 token: str | None = None,
                  obs_shape=(), obs_dtype: str = "<f4",
                  transport: str = "tcp", pin_version: int | None = None,
                  trace: str | None = None) -> bytes:
@@ -85,6 +105,7 @@ def encode_hello(tenant: str, *, session: str | None = None,
         {
             "tenant": str(tenant),
             "session": session,
+            "token": token,
             "obs_shape": [int(d) for d in obs_shape],
             "obs_dtype": str(obs_dtype),
             "transport": transport,
@@ -95,10 +116,12 @@ def encode_hello(tenant: str, *, session: str | None = None,
 
 
 def encode_hello_ok(session: str, lease_s: float, transport: str,
-                    replica: int, pinned_version: int | None = None) -> bytes:
+                    replica: int, pinned_version: int | None = None,
+                    token: str | None = None) -> bytes:
     return MAGIC + bytes([GHELLO_OK]) + json.dumps(
         {
             "session": session,
+            "token": token,
             "lease_s": float(lease_s),
             "transport": transport,
             "replica": int(replica),
@@ -168,44 +191,67 @@ def encode_journal(op: dict) -> bytes:
 def decode_payload(payload: bytes) -> tuple[str, Any]:
     """Route one gateway frame -> (kind, obj): parsed JSON for control
     frames, a header dict (with a ``body`` memoryview) for ACT/ACT_OK,
-    or the unpickled dict for 'msg' — the pickle fallback, deserialized
-    HERE, the one place the gateway may unpickle."""
-    if payload[:4] == MAGIC:
-        kind = payload[4]
-        body = memoryview(payload)[5:]
-        if kind in (GHELLO, GHELLO_OK, GHELLO_NO, DETACH, DETACH_OK,
-                    ACT_ERR, JOURNAL):
-            name = {
-                GHELLO: "hello", GHELLO_OK: "hello_ok",
-                GHELLO_NO: "hello_no", DETACH: "detach",
-                DETACH_OK: "detach_ok", ACT_ERR: "act_err",
-                JOURNAL: "journal",
-            }[kind]
-            return name, json.loads(bytes(body).decode())
-        if kind == ACT:
-            sid, seq, flags, t_send = _ACT_HDR.unpack_from(body, 0)
-            return "act", {
-                "session": sid.decode(), "seq": seq, "flags": flags,
-                "t_send": t_send, "body": body[_ACT_HDR.size:],
-            }
-        if kind == ACT_OK:
-            seq, version, flags, meta_len, t_send = _ACTOK_HDR.unpack_from(
-                body, 0
-            )
-            off = _ACTOK_HDR.size
-            meta = json.loads(bytes(body[off:off + meta_len]).decode())
-            return "act_ok", {
-                "seq": seq, "version": version, "flags": flags,
-                "t_send": t_send, "meta": meta,
-                "body": body[off + meta_len:],
-            }
-        raise ValueError(f"unknown gateway frame kind {kind}")
-    return "msg", pickle.loads(payload)
+    or the STILL-PICKLED fallback envelope for 'pmsg' — decoding never
+    deserializes tenant bytes; the caller gates
+    :func:`decode_pickle_body` on the session's negotiated transport.
+    Anything not MAGIC-prefixed raises ``ValueError`` (it is not a
+    gateway frame, and must certainly not be fed to pickle)."""
+    if payload[:4] != MAGIC:
+        raise ValueError("not a gateway frame (no MAGIC prefix)")
+    kind = payload[4]
+    body = memoryview(payload)[5:]
+    if kind in (GHELLO, GHELLO_OK, GHELLO_NO, DETACH, DETACH_OK,
+                ACT_ERR, JOURNAL):
+        name = {
+            GHELLO: "hello", GHELLO_OK: "hello_ok",
+            GHELLO_NO: "hello_no", DETACH: "detach",
+            DETACH_OK: "detach_ok", ACT_ERR: "act_err",
+            JOURNAL: "journal",
+        }[kind]
+        return name, json.loads(bytes(body).decode())
+    if kind == ACT:
+        sid, seq, flags, t_send = _ACT_HDR.unpack_from(body, 0)
+        return "act", {
+            "session": sid.decode(), "seq": seq, "flags": flags,
+            "t_send": t_send, "body": body[_ACT_HDR.size:],
+        }
+    if kind == ACT_OK:
+        seq, version, flags, meta_len, t_send = _ACTOK_HDR.unpack_from(
+            body, 0
+        )
+        off = _ACTOK_HDR.size
+        meta = json.loads(bytes(body[off:off + meta_len]).decode())
+        return "act_ok", {
+            "seq": seq, "version": version, "flags": flags,
+            "t_send": t_send, "meta": meta,
+            "body": body[off + meta_len:],
+        }
+    if kind == PMSG:
+        if len(body) < SID_BYTES:
+            raise ValueError("PMSG frame shorter than a session id")
+        return "pmsg", {
+            "session": bytes(body[:SID_BYTES]).decode(),
+            "body": body[SID_BYTES:],
+        }
+    raise ValueError(f"unknown gateway frame kind {kind}")
 
 
-def encode_pickle_msg(msg: dict) -> bytes:
-    """Fallback-transport message (whole dict, ndarray payloads included)."""
-    return pickle.dumps(msg, protocol=5)
+def encode_pickle_act(session: str, msg: dict) -> bytes:
+    """Fallback-transport act request: the session id rides in the clear
+    ahead of the pickled dict (ndarray payloads included), so the server
+    can refuse to unpickle for sessions that did not negotiate it."""
+    sid = session.encode()
+    if len(sid) != SID_BYTES:
+        raise ValueError(f"session id must be {SID_BYTES} bytes, got {sid!r}")
+    return MAGIC + bytes([PMSG]) + sid + pickle.dumps(msg, protocol=5)
+
+
+def decode_pickle_body(body) -> Any:
+    """Deserialize a PMSG envelope's pickled dict — the ONE place the
+    gateway may unpickle, and only legal AFTER the server has verified
+    the envelope's session exists and negotiated ``transport='pickle'``
+    (unpickling unvetted tenant bytes is arbitrary code execution)."""
+    return pickle.loads(bytes(body))
 
 
 def decode_act_ok(obj: dict) -> tuple[np.ndarray, dict]:
@@ -240,10 +286,11 @@ class GatewaySession:
     reason."""
 
     def __init__(self, address: str, tenant: str = "default", *,
-                 session: str | None = None, obs_shape=(),
-                 obs_dtype: str = "<f4", transport: str = "tcp",
-                 pin_version: int | None = None, trace: str | None = None,
-                 timeout_s: float = 5.0, retries: int = 3):
+                 session: str | None = None, token: str | None = None,
+                 obs_shape=(), obs_dtype: str = "<f4",
+                 transport: str = "tcp", pin_version: int | None = None,
+                 trace: str | None = None, timeout_s: float = 5.0,
+                 retries: int = 3):
         if transport not in ("tcp", "pickle"):
             raise ValueError(f"transport {transport!r} not in tcp|pickle")
         self.tenant = str(tenant)
@@ -261,22 +308,25 @@ class GatewaySession:
         self._sock.connect(address)
         self._address = address
         self.session: str | None = None
+        # the resume credential from GHELLO_OK: pass it (with the
+        # session id) to a new GatewaySession to re-attach after churn
+        self.token: str | None = token
         self.lease_s: float | None = None
         self.replica: int | None = None
         self.pinned_version: int | None = None
-        self._attach(session, pin_version, trace)
+        self._attach(session, token, pin_version, trace)
 
     def _recv(self, timeout_s: float) -> tuple[str, Any] | None:
         if not self._sock.poll(int(timeout_s * 1e3)):
             return None
         return decode_payload(self._sock.recv())
 
-    def _attach(self, session: str | None, pin_version: int | None,
-                trace: str | None) -> None:
+    def _attach(self, session: str | None, token: str | None,
+                pin_version: int | None, trace: str | None) -> None:
         hello = encode_hello(
-            self.tenant, session=session, obs_shape=self.obs_shape,
-            obs_dtype=self.obs_dtype.str, transport=self.transport,
-            pin_version=pin_version, trace=trace,
+            self.tenant, session=session, token=token,
+            obs_shape=self.obs_shape, obs_dtype=self.obs_dtype.str,
+            transport=self.transport, pin_version=pin_version, trace=trace,
         )
         for _ in range(self.retries):
             self._sock.send(hello)
@@ -288,6 +338,7 @@ class GatewaySession:
                 raise GatewayError(obj["reason"])
             if kind == "hello_ok":
                 self.session = obj["session"]
+                self.token = obj.get("token") or self.token
                 self.lease_s = float(obj["lease_s"])
                 self.replica = int(obj["replica"])
                 self.pinned_version = obj.get("pinned_version")
@@ -305,9 +356,9 @@ class GatewaySession:
         self._seq += 1
         seq = self._seq
         if self.transport == "pickle":
-            frame = encode_pickle_msg({
-                "kind": "act", "session": self.session, "seq": seq,
-                "obs": obs, "t_send": time.time(),
+            frame = encode_pickle_act(self.session, {
+                "kind": "act", "seq": seq, "obs": obs,
+                "t_send": time.time(),
             })
         else:
             frame = encode_act(self.session, seq, obs, t_send=time.time())

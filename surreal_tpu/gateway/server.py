@@ -29,11 +29,21 @@ arithmetic from this package). Each loop pass:
    in a bounded LRU act cache keyed on (version, obs digest); duplicate
    observations at the same version skip the forward entirely
    (hit/miss counted).
+
+Input hardening: every frame is served behind a frame boundary — a
+malformed, truncated, or hostile payload is counted
+(``gateway/bad_frames``) and answered where possible, never allowed to
+unwind the serve loop (a crashing frame would be a remote
+denial-of-service through the respawn backoff). The pickle fallback only
+deserializes for sessions that negotiated it (see
+``gateway/protocol.py``), and re-attach requires the granted resume
+token, not just a session id.
 """
 
 from __future__ import annotations
 
 import hashlib
+import struct
 import time
 import threading
 import zlib
@@ -96,6 +106,10 @@ class GatewayServer:
         # with it); lives beside the table but is NOT journaled — a
         # re-attaching client re-negotiates it in the hello
         self._obs_specs: dict[str, tuple[tuple, np.dtype]] = {}
+        # per-session resume tokens: the re-attach credential (the
+        # session id alone routes but does not authenticate). Not
+        # journaled — a credential never crosses the checkpoint wire.
+        self._resume_tokens: dict[str, str] = {}
         self._cache_cap = int(act_cache)
         self._cache: "OrderedDict[tuple, tuple[np.ndarray, int]]" = (
             OrderedDict()
@@ -108,6 +122,7 @@ class GatewayServer:
         self.cache_misses = 0
         self.catch_ups = 0
         self.dropped_replies = 0
+        self.bad_frames = 0
         self.respawns = 0
         self.respawn_backoff_s = 0.0
         # act round-trip serve time (recv -> reply), rolling window —
@@ -180,6 +195,7 @@ class GatewayServer:
                 for rec in expired:
                     self._release_pin(rec)
                     self._obs_specs.pop(rec.session, None)
+                    self._resume_tokens.pop(rec.session, None)
             for tenant in list(self.admission.tenants()):
                 for req in self.admission.drain(tenant):
                     self._serve_one(sock, req)
@@ -191,44 +207,93 @@ class GatewayServer:
                 except zmq.Again:
                     break
                 try:
-                    kind, obj = gw.decode_payload(payload)
-                except (ValueError, KeyError, EOFError):
-                    continue  # not ours; never crash the tier on input
-                if kind == "hello":
-                    self._handle_hello(sock, ident, obj)
-                elif kind == "act":
-                    obs = self._act_obs(obj)
-                    if obs is None:
-                        self._reply(sock, ident, gw.encode_act_err(
-                            obj["seq"], "unknown session", obj["session"]
-                        ))
-                        continue
-                    self._admit_act(
-                        sock, (ident, obj["session"], obj["seq"], obs)
-                    )
-                elif kind == "msg" and obj.get("kind") == "act":
-                    # the negotiated pickle fallback request
-                    rec = self.table.get(str(obj.get("session", "")))
-                    if rec is None:
-                        self._reply(sock, ident, gw.encode_act_err(
-                            int(obj.get("seq", 0)), "unknown session",
-                            str(obj.get("session", "")),
-                        ))
-                        continue
-                    self._admit_act(
-                        sock,
-                        (ident, rec.session, int(obj["seq"]),
-                         np.asarray(obj["obs"])),
-                    )
-                elif kind == "detach":
-                    rec = self.table.detach(obj["session"])
-                    if rec is not None:
-                        self.detaches += 1
-                        self._release_pin(rec)
-                        self._obs_specs.pop(rec.session, None)
-                    self._reply(sock, ident, gw.encode_detach_ok(
-                        obj["session"], rec.acts if rec else 0
-                    ))
+                    self._handle_frame(sock, ident, payload)
+                except Exception:
+                    # the frame boundary: ANY tenant frame — malformed,
+                    # truncated, hostile — is counted and dropped here;
+                    # one bad frame must never unwind the serve loop
+                    # into a respawn-backoff outage (the "never crash
+                    # the tier on input" guard, made total)
+                    self.bad_frames += 1
+
+    def _handle_frame(self, sock, ident: bytes, payload: bytes) -> None:
+        """Serve ONE tenant frame. Raising is allowed — the caller's
+        frame boundary counts it — but every anticipated bad input is
+        answered with a reasoned reply instead."""
+        try:
+            kind, obj = gw.decode_payload(payload)
+        except (ValueError, KeyError, IndexError, EOFError, struct.error):
+            # not ours / truncated header / garbage: counted, never
+            # crashes the tier, never reaches a deserializer
+            self.bad_frames += 1
+            return
+        if kind == "hello":
+            self._handle_hello(sock, ident, obj)
+        elif kind == "act":
+            sid = obj["session"]
+            try:
+                obs = self._act_obs(obj)
+            except ValueError as e:
+                # negotiated-spec mismatch (wrong body length): a
+                # reasoned reply, not a frombuffer crash
+                self.bad_frames += 1
+                self._reply(sock, ident, gw.encode_act_err(
+                    obj["seq"], f"bad obs body: {e}", sid
+                ))
+                return
+            if obs is None:
+                self._reply(sock, ident, gw.encode_act_err(
+                    obj["seq"], "unknown session", sid
+                ))
+                return
+            self._admit_act(sock, (ident, sid, obj["seq"], obs))
+        elif kind == "pmsg":
+            self._handle_pmsg(sock, ident, obj)
+        elif kind == "detach":
+            rec = self.table.detach(obj["session"])
+            if rec is not None:
+                self.detaches += 1
+                self._release_pin(rec)
+                self._obs_specs.pop(rec.session, None)
+                self._resume_tokens.pop(rec.session, None)
+            self._reply(sock, ident, gw.encode_detach_ok(
+                obj["session"], rec.acts if rec else 0
+            ))
+
+    def _handle_pmsg(self, sock, ident: bytes, obj: dict) -> None:
+        """The negotiated pickle-fallback act request. The envelope's
+        session id is checked against the table BEFORE any unpickling:
+        only a session that negotiated ``transport='pickle'`` gets its
+        bytes deserialized — an unauthenticated ident cannot reach
+        ``pickle.loads`` (that would be remote code execution)."""
+        sid = obj["session"]
+        rec = self.table.get(sid)
+        if rec is None:
+            self._reply(sock, ident, gw.encode_act_err(
+                0, "unknown session", sid
+            ))
+            return
+        if rec.transport != "pickle":
+            self.bad_frames += 1
+            self._reply(sock, ident, gw.encode_act_err(
+                0, "pickle transport not negotiated for this session", sid
+            ))
+            return
+        try:
+            msg = gw.decode_pickle_body(obj["body"])
+            if not isinstance(msg, dict) or msg.get("kind") != "act":
+                raise ValueError("fallback frame is not an act dict")
+            seq = int(msg["seq"])
+            obs = np.asarray(msg["obs"])
+        except Exception:
+            # corrupt/hostile fallback body: counted + answered; the
+            # session (and the tier) survive the frame
+            self.bad_frames += 1
+            self._reply(sock, ident, gw.encode_act_err(
+                0, "undecodable fallback act frame", sid
+            ))
+            return
+        self._admit_act(sock, (ident, rec.session, seq, obs))
 
     def _apply_fault(self, f: dict) -> None:
         kind = f["kind"]
@@ -283,17 +348,40 @@ class GatewayServer:
             ))
             return
         tenant = str(obj.get("tenant", "default"))
+        try:
+            spec = self._parse_obs_spec(obj)
+        except (TypeError, ValueError) as e:
+            # a bad shape/dtype is the tenant's error, not the tier's
+            # crash: reasoned GHELLO_NO before anything is installed
+            self._reply(sock, ident, gw.encode_hello_no(
+                f"bad obs spec: {e}"
+            ))
+            return
         sid = obj.get("session")
         if sid:
-            rec = self.table.touch(str(sid))
+            rec = self.table.get(str(sid))
             if rec is not None:
                 # re-attach after client churn: the gateway owns the
-                # mapping, so the binding (and any pin) survives
+                # mapping, so the binding (and any pin) survives — but
+                # the resumer must prove ownership (same tenant AND the
+                # granted resume token) before the record is touched; a
+                # guessed session id resumes nothing and renews nothing
+                if (
+                    tenant != rec.tenant
+                    or obj.get("token") != self._resume_tokens.get(rec.session)
+                ):
+                    self.admission.note_rejected(tenant)
+                    self._reply(sock, ident, gw.encode_hello_no(
+                        "session resume denied (tenant/token mismatch)"
+                    ))
+                    return
+                self.table.touch(rec.session)
                 self.reattaches += 1
-                self._install_obs_spec(rec.session, obj)
+                self._obs_specs[rec.session] = spec
                 self._reply(sock, ident, gw.encode_hello_ok(
                     rec.session, self.lease_s, rec.transport,
                     rec.replica, rec.pinned_version,
+                    token=self._resume_tokens.get(rec.session),
                 ))
                 return
         reason = self.admission.admit_session(
@@ -322,19 +410,25 @@ class GatewayServer:
         else:
             pin = None
         sid = gw.new_session_id()
+        token = gw.new_resume_token()
         replica = self.fleet.replica_of(zlib.crc32(sid.encode()))
         rec = SessionRecord(
             sid, tenant, replica, transport=transport, pinned_version=pin
         )
         self.table.attach(rec)
         self.attaches += 1
-        self._install_obs_spec(sid, obj)
+        self._obs_specs[sid] = spec
+        self._resume_tokens[sid] = token
         self._reply(sock, ident, gw.encode_hello_ok(
-            sid, self.lease_s, transport, replica, pin
+            sid, self.lease_s, transport, replica, pin, token=token
         ))
 
-    def _install_obs_spec(self, sid: str, obj: dict) -> None:
-        self._obs_specs[sid] = (
+    @staticmethod
+    def _parse_obs_spec(obj: dict) -> tuple[tuple, np.dtype]:
+        """Validate the hello's obs geometry up front (``np.dtype`` on a
+        hostile string raises TypeError — that belongs in a GHELLO_NO,
+        not the serve loop)."""
+        return (
             tuple(int(d) for d in obj.get("obs_shape", ())),
             np.dtype(obj.get("obs_dtype", "<f4")),
         )
@@ -344,7 +438,14 @@ class GatewayServer:
         if spec is None:
             return None
         shape, dtype = spec
-        return np.frombuffer(obj["body"], dtype).reshape(shape)
+        body = obj["body"]
+        expected = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+        if body.nbytes != expected:
+            raise ValueError(
+                f"{body.nbytes} bytes against negotiated spec "
+                f"{shape}/{dtype.str} ({expected} bytes)"
+            )
+        return np.frombuffer(body, dtype).reshape(shape)
 
     def _admit_act(self, sock, req: tuple) -> None:
         ident, sid, seq, obs = req
@@ -375,10 +476,20 @@ class GatewayServer:
             return
         t0 = time.monotonic()
         flags = 0
-        version_key = (
-            rec.pinned_version if rec.pinned_version is not None
-            else self.fleet.version
-        )
+        if (
+            rec.pinned_version is not None
+            and rec.pinned_version not in self.fleet.held_versions()
+        ):
+            # the pin's closure is already gone: catch up BEFORE the
+            # cache lookup, so a dead pin cannot keep serving stale
+            # cached hits without ever hitting the counted path — and
+            # drop the evicted version's cache entries with it
+            self.catch_ups += 1
+            self._purge_cache_version(rec.pinned_version)
+            self._release_pin(rec)
+            self.table.pin(sid, None)
+            flags |= gw.F_UNPINNED
+        version_key = self._version_key(rec)
         digest = None
         if self._cache_cap > 0:
             digest = hashlib.blake2b(
@@ -400,11 +511,13 @@ class GatewayServer:
             )
         except KeyError:
             # (before LookupError: KeyError IS a LookupError.) the
-            # pinned closure was evicted from the act history: the
-            # counted catch_up path — unpin EXPLICITLY (F_UNPINNED on
-            # the reply) and serve the current version; never a silent
-            # jump
+            # pinned closure was evicted from the act history BETWEEN
+            # the held check above and the serve (set_act_fn runs on
+            # the training thread): the counted catch_up path — unpin
+            # EXPLICITLY (F_UNPINNED on the reply) and serve the
+            # current version; never a silent jump
             self.catch_ups += 1
+            self._purge_cache_version(rec.pinned_version)
             self._release_pin(rec)
             self.table.pin(sid, None)
             flags |= gw.F_UNPINNED
@@ -430,6 +543,7 @@ class GatewayServer:
                 )
             except KeyError:
                 self.catch_ups += 1
+                self._purge_cache_version(rec.pinned_version)
                 self._release_pin(rec)
                 self.table.pin(sid, None)
                 flags |= gw.F_UNPINNED
@@ -464,6 +578,29 @@ class GatewayServer:
             seq, served, actions, flags=flags, t_send=time.time()
         ))
 
+    def _version_key(self, rec: SessionRecord) -> int:
+        """The cache-lookup version: the version a forward for this
+        session WOULD serve — the pin, else the bound replica's APPLIED
+        version (the same counter ``serve_act`` returns as ``served``,
+        which is the store key), so lookups and stores share one source
+        and a ``set_act_fn`` propagation lag cannot systematically
+        miss."""
+        if rec.pinned_version is not None:
+            return int(rec.pinned_version)
+        srv = (
+            self.fleet._replicas[rec.replica]
+            if 0 <= rec.replica < len(self.fleet._replicas) else None
+        )
+        if srv is not None and srv.alive:
+            return int(srv.version)
+        return int(self.fleet.version)
+
+    def _purge_cache_version(self, version: int | None) -> None:
+        """Drop every cache entry served at ``version`` (an evicted
+        pin's entries must not outlive its closure)."""
+        for key in [k for k in self._cache if k[0] == version]:
+            del self._cache[key]
+
     def _release_pin(self, rec: SessionRecord) -> None:
         if self.fanout is not None and rec.pinned_version is not None:
             self.fanout.release_pin(rec.pinned_version)
@@ -486,6 +623,7 @@ class GatewayServer:
                 sum(self.table.pinned_versions().values())
             ),
             "gateway/dropped_replies": float(self.dropped_replies),
+            "gateway/bad_frames": float(self.bad_frames),
             "gateway/respawns": float(self.respawns),
         }
         out.update(self.admission.gauges())

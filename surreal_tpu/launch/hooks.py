@@ -234,6 +234,13 @@ class SessionHooks:
         self._steps0 = 0
 
     @property
+    def fanout(self):
+        """The live :class:`ParameterFanout` (None unless
+        ``publish.fanout.enabled``) — the gateway's publisher-side
+        pinned-version holds need it."""
+        return self._fanout
+
+    @property
     def last_metrics(self) -> dict[str, float]:
         """Latest synced train metrics merged with latest eval metrics."""
         return {**self._last_train, **self._last_eval}

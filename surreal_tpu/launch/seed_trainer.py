@@ -578,6 +578,9 @@ class SEEDTrainer:
                     tenant_quotas=gw_cfg.get("tenant_quotas", None),
                     act_cache=int(gw_cfg.get("act_cache", 256)),
                     pin_versions=bool(gw_cfg.get("pin_versions", True)),
+                    # the hooks-owned ParameterFanout: session pins also
+                    # hold the pinned version's full frame publisher-side
+                    fanout=hooks.fanout,
                     trace_id=hooks.trace_id,
                     respawn_backoff_s=float(
                         gw_cfg.get("respawn_backoff_s", 0.5)

@@ -162,13 +162,19 @@ GAUGE_REGISTRY = {
         "act replies swallowed by fault injection (gateway.session "
         "drop_frame); the client's bounded resend redelivers."
     ),
+    "gateway/bad_frames": (
+        "malformed/hostile tenant frames dropped at the serve loop's "
+        "frame boundary (truncated headers, bad obs bodies, undecodable "
+        "or un-negotiated pickle fallbacks) — counted, never a crash."
+    ),
     "gateway/respawns": (
         "gateway serve-thread respawns performed by its supervisor "
         "(in place, fixed address, shared backoff schedule)."
     ),
     # admission plane (gateway/admission.py)
     "gateway/rejected_sessions": (
-        "attach attempts refused by session quota (global or per-tenant)."
+        "attach attempts refused — by session quota (global or "
+        "per-tenant) or by the re-attach tenant/token credential check."
     ),
     "gateway/throttled_acts": (
         "acts past a tenant's token-bucket rate, parked in its bounded "

@@ -112,21 +112,65 @@ def test_gateway_attach_act_detach_roundtrip_both_transports():
 
 def test_gateway_reattach_keeps_binding_and_quota():
     """Client churn is not session churn: re-attaching with the granted
-    session id lands on the SAME record (binding, pin, quota slot) —
-    counted as a re-attach, not an attach."""
+    session id AND resume token lands on the SAME record (binding, pin,
+    quota slot) — counted as a re-attach, not an attach."""
     fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
     server = _gateway(fleet)
     try:
         s1 = GatewaySession(server.address, obs_shape=(1, 4))
-        sid, replica = s1.session, s1.replica
+        sid, token, replica = s1.session, s1.token, s1.replica
+        assert token, "attach granted no resume token"
         s1._sock.close(0)  # vanish without detaching (no lease reap yet)
         s2 = GatewaySession(
-            server.address, session=sid, obs_shape=(1, 4)
+            server.address, session=sid, token=token, obs_shape=(1, 4)
         )
         assert s2.session == sid and s2.replica == replica
+        assert s2.token == token
         assert server.reattaches == 1 and server.attaches == 1
         assert server.gauges()["gateway/sessions"] == 1.0
         s2.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_reattach_requires_tenant_and_token():
+    """The session id routes but does not authenticate: resuming another
+    tenant's session needs the granted resume token AND the owning
+    tenant name — a guessed/leaked id gets a reasoned GHELLO_NO (counted)
+    and does not renew the victim's lease or overwrite its obs spec."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        victim = GatewaySession(
+            server.address, tenant="alpha", obs_shape=(2, 4)
+        )
+        sid, token = victim.session, victim.token
+        spec_before = server._obs_specs[sid]
+        # right id, no token
+        with pytest.raises(GatewayError, match="resume denied"):
+            GatewaySession(
+                server.address, tenant="alpha", session=sid,
+                obs_shape=(9, 9),
+            )
+        # right id + token, wrong tenant
+        with pytest.raises(GatewayError, match="resume denied"):
+            GatewaySession(
+                server.address, tenant="mallory", session=sid,
+                token=token, obs_shape=(9, 9),
+            )
+        assert server._obs_specs[sid] == spec_before
+        assert server.reattaches == 0
+        assert server.gauges()["gateway/rejected_sessions"] == 2.0
+        # the rightful owner still resumes
+        s2 = GatewaySession(
+            server.address, tenant="alpha", session=sid, token=token,
+            obs_shape=(2, 4),
+        )
+        assert s2.session == sid and server.reattaches == 1
+        s2.act(np.zeros((2, 4), np.float32))
+        s2.close()
+        victim._sock.close(0)
     finally:
         server.close()
         fleet.close()
@@ -172,6 +216,111 @@ def test_gateway_quota_rejection_and_backpressure_eviction_counted():
         g = server.gauges()
         assert g["gateway/throttled_acts"] >= 3.0
         assert g["gateway/evicted_requests"] == 1.0
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+_PICKLE_TRIPPED = []
+
+
+def _trip_canary():
+    # unpickling tenant bytes would execute this (the RCE shape the
+    # gateway must never expose); the tests assert it stays empty
+    _PICKLE_TRIPPED.append(True)
+    return {}
+
+
+class _PickleCanary:
+    def __reduce__(self):
+        return (_trip_canary, ())
+
+
+def test_gateway_serve_loop_survives_malformed_and_hostile_frames():
+    """The frame boundary: garbage bytes, truncated headers, wrong-size
+    obs bodies, and hostile pickles are counted (`gateway/bad_frames`)
+    and answered where possible — the serve thread never dies (a
+    crashing frame would be a remote DoS through the respawn backoff),
+    and tenant bytes are never unpickled unless THAT session negotiated
+    the fallback (the canary proves it)."""
+    import pickle
+
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        sess = GatewaySession(server.address, obs_shape=(1, 2))
+        hostile = [
+            b"",
+            b"garbage that is not a gateway frame",
+            pickle.dumps(_PickleCanary()),   # bare pickle: never loaded
+            gw.MAGIC,                        # no kind byte
+            gw.MAGIC + bytes([gw.ACT]) + b"\x01",      # truncated header
+            gw.MAGIC + bytes([gw.ACT_ERR]) + b"{not json",
+            gw.MAGIC + bytes([123]),                   # unknown kind
+            gw.MAGIC + bytes([gw.PMSG]) + b"short",    # no session id
+            # a PMSG naming a session that negotiated tcp, NOT pickle:
+            # the body must never reach pickle.loads
+            gw.MAGIC + bytes([gw.PMSG]) + sess.session.encode()
+            + pickle.dumps(_PickleCanary()),
+        ]
+        for frame in hostile:
+            sess._sock.send(frame)
+        # a wrong-size obs body against the negotiated spec gets a
+        # REASONED reply, not a frombuffer crash
+        sess._sock.send(
+            gw.encode_act(sess.session, 77, np.zeros(9, np.float32))
+        )
+        got_err = None
+        deadline = time.monotonic() + 10
+        while got_err is None and time.monotonic() < deadline:
+            if not sess._sock.poll(1000):
+                continue
+            kind, obj = gw.decode_payload(sess._sock.recv())
+            if kind == "act_err" and obj["seq"] == 77:
+                got_err = obj
+        assert got_err is not None, "no reasoned reply to the bad act"
+        assert "bad obs body" in got_err["reason"]
+        assert not _PICKLE_TRIPPED, "tenant bytes were unpickled"
+        assert server.alive and server.respawns == 0
+        assert server.gauges()["gateway/bad_frames"] >= 9.0
+        # the tier still serves after the barrage
+        actions, _ = sess.act(np.zeros((1, 2), np.float32))
+        assert actions.shape == (1,)
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_pickle_fallback_is_gated_per_session():
+    """A pickle-negotiated session's own fallback frames serve, but a
+    corrupt fallback body is a counted, reasoned error — and the session
+    keeps serving afterwards."""
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        sess = GatewaySession(
+            server.address, obs_shape=(1, 2), transport="pickle"
+        )
+        a, _ = sess.act(np.zeros((1, 2), np.float32))
+        assert a.shape == (1,)
+        sess._sock.send(
+            gw.MAGIC + bytes([gw.PMSG]) + sess.session.encode()
+            + b"\x00not a pickle"
+        )
+        deadline = time.monotonic() + 10
+        got = None
+        while got is None and time.monotonic() < deadline:
+            if not sess._sock.poll(1000):
+                continue
+            kind, obj = gw.decode_payload(sess._sock.recv())
+            if kind == "act_err":
+                got = obj
+        assert got is not None and "undecodable" in got["reason"]
+        assert server.gauges()["gateway/bad_frames"] >= 1.0
+        a, _ = sess.act(np.ones((1, 2), np.float32))
+        assert a.shape == (1,)
         sess.close()
     finally:
         server.close()
@@ -276,6 +425,42 @@ def test_gateway_act_cache_is_version_keyed_and_bounded():
         for i in range(8):  # roll the tiny LRU over its bound
             sess.act(np.full((1, 2), 100 + i, np.float32))
         assert len(server._cache) <= 4
+        sess.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
+def test_gateway_act_cache_purges_dead_pin_entries():
+    """A pinned session whose version was evicted must NOT keep serving
+    stale cached hits at the dead pin: the next act takes the counted
+    catch_up (F_UNPINNED), the evicted version's cache entries are
+    purged, and the action comes from the LIVE closure."""
+    fleet = InferenceFleet(
+        _versioned_act_fn(0), num_workers=2, replicas=2, unroll_length=4,
+        act_history=2,
+    )
+    server = _gateway(fleet)
+    try:
+        fleet.set_act_fn(_versioned_act_fn(1))
+        sess = GatewaySession(
+            server.address, obs_shape=(1, 3), pin_version=0
+        )
+        obs = np.ones((1, 3), np.float32)
+        a0, info = sess.act(obs)
+        assert a0[0] == 0 and info["param_version"] == 0
+        _, info = sess.act(obs)
+        assert info["cached"] is True and info["param_version"] == 0
+        # evict v0; the SAME obs must not hit the dead pin's cache entry
+        fleet.set_act_fn(_versioned_act_fn(2))
+        fleet.set_act_fn(_versioned_act_fn(3))
+        assert 0 not in fleet.held_versions()
+        a_cu, info_cu = sess.act(obs)
+        assert info_cu["cached"] is False
+        assert info_cu["unpinned"] is True
+        assert a_cu[0] == 3 and info_cu["param_version"] == fleet.version
+        assert server.gauges()["gateway/catch_ups"] == 1.0
+        assert not any(k[0] == 0 for k in server._cache)
         sess.close()
     finally:
         server.close()
