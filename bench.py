@@ -294,6 +294,13 @@ def main() -> int:
         from perf_wallclock import gateway_main
 
         return gateway_main(sys.argv[1:])
+    if "--ops-plane" in sys.argv:
+        # ops-plane campaign (ISSUE 13): per-cadence tier push +
+        # snapshot-build/SLO cost against steady-state iteration time —
+        # writes BENCH_ops.json (perf_gate's ops gate consumes it)
+        from perf_wallclock import ops_plane_main
+
+        return ops_plane_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

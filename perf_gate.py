@@ -388,13 +388,131 @@ def gate_gateway(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_ops(art_dir: str, out=sys.stdout) -> int:
+    """The ops-plane overhead commitments (ISSUE 13), from
+    ``BENCH_ops.json`` (``python bench.py --ops-plane``):
+
+    - observability must never become the workload: building + writing
+      one merged run snapshot (SLO evaluation included) costs <=
+      ``snapshot_frac_max`` (5%) of one steady-state train iteration at
+      the committed headline geometry;
+    - a tier's push must stay serve-loop cheap: push p99 under 1 ms
+      (non-blocking send of one JSON row — anything slower would tax
+      every gateway/replica/shard loop pass).
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_ops.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_ops.json — ops plane not measured "
+              "(rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_ops.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    # default mirrors the producer's bound (perf_wallclock.py
+    # OPS_SNAPSHOT_FRAC_MAX) so a field-less artifact can't flip the verdict
+    frac_max = float(data.get("snapshot_frac_max", 0.05))
+    snap = (data.get("snapshot_ms") or {}).get("p50")
+    iter_ms = data.get("iter_ms")
+    if snap is not None and iter_ms is not None and float(iter_ms) > 0:
+        frac = float(snap) / float(iter_ms)
+        line = (
+            f"perf_gate: ops snapshot build p50 {float(snap):.3f} ms vs "
+            f"iteration {float(iter_ms):.1f} ms ({frac:.2%} of the "
+            f"iteration, commitment <= {frac_max:.0%})"
+        )
+        if frac > frac_max:
+            print(line + " — OBSERVABILITY BECAME THE WORKLOAD", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    push = (data.get("push_ms") or {}).get("p99")
+    if push is not None:
+        line = (
+            f"perf_gate: ops tier push p99 {float(push):.4f} ms "
+            "(commitment < 1 ms on the serve loop)"
+        )
+        if float(push) >= 1.0:
+            print(line + " — PUSH TAXES THE SERVE LOOP", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    return rc
+
+
+def gate_tier1(art_dir: str, out=sys.stdout) -> int:
+    """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
+    committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
+    run: wall_s, passed/failed, worst offenders) must stay inside the
+    budget its ROADMAP note claims, and the note must cite the SAME
+    budget the verify command enforces — the "runtime is a real
+    constraint" sentence can never silently go stale.
+
+    rc 0 with a note when no audit is committed."""
+    path = os.path.join(art_dir, "BENCH_tier1.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_tier1.json — tier-1 runtime not "
+              "audited (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("wall_s") is None:
+        print("perf_gate: BENCH_tier1.json carries no wall_s (rc 0)",
+              file=out)
+        return 0
+    rc = 0
+    wall = float(data["wall_s"])
+    budget = float(data.get("budget_s", 870))
+    line = (
+        f"perf_gate: tier-1 suite {wall:.0f} s of the {budget:.0f} s "
+        f"budget ({data.get('passed', '?')} passed, "
+        f"{data.get('failed', '?')} failed)"
+    )
+    if wall > budget:
+        print(line + " — OVER BUDGET (mark offenders slow or raise the "
+              "budget WITH the ROADMAP note)", file=out)
+        rc = 1
+    elif wall > 0.95 * budget:
+        print(line + " — ok, but within 5% of the ceiling", file=out)
+    else:
+        print(line + " — ok", file=out)
+    if int(data.get("failed", 0) or 0) > 0:
+        print("perf_gate: the committed tier-1 audit records FAILURES — "
+              "an audit of a red suite must not be the committed record",
+              file=out)
+        rc = 1
+    # the honesty half: ROADMAP's verify command must enforce the same
+    # budget the audit was judged against
+    try:
+        with open(os.path.join(art_dir, "ROADMAP.md")) as f:
+            roadmap = f.read()
+    except OSError:
+        roadmap = ""
+    if roadmap and f"timeout -k 10 {int(budget)}" not in roadmap:
+        print(
+            f"perf_gate: BENCH_tier1.json budget_s={int(budget)} but "
+            "ROADMAP.md's tier-1 command enforces a DIFFERENT timeout — "
+            "the wall-clock note went stale", file=out,
+        )
+        rc = 1
+    return rc
+
+
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
-    # the experience-plane, act-path, and gateway gates are independent
-    # of the BENCH_r* trail: run them first and fold their verdicts into
-    # every return path
+    # the experience-plane, act-path, gateway, ops-plane, and tier-1
+    # budget gates are independent of the BENCH_r* trail: run them first
+    # and fold their verdicts into every return path
     xp_rc = max(
         gate_experience(art_dir, out=out), gate_act(art_dir, out=out),
-        gate_gateway(art_dir, out=out),
+        gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
+        gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

@@ -1274,6 +1274,99 @@ def _gateway_lines() -> list[str]:
     return lines
 
 
+def _load_ops_bench():
+    """Load the ops-plane artifact (``BENCH_ops.json``, written by
+    ``bench.py --ops-plane``) if present — same BENCH_host.json
+    discipline: PERF.md regens preserve the measured section without
+    re-running the campaign."""
+    try:
+        with open("BENCH_ops.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or data.get("value") is None:
+        return None  # failed-campaign artifact
+    return data
+
+
+def _ops_plane_lines() -> list[str]:
+    """The 'Live ops plane' PERF.md section: static mechanism text plus
+    the measured per-cadence cost table from the BENCH_ops.json
+    artifact. One function so ``main()`` and the committed PERF.md
+    cannot drift."""
+    lines = [
+        "",
+        "## Live ops plane (cross-tier aggregation, per-tenant SLOs, "
+        "flight recorder)",
+        "",
+        "Telemetry was post-hoc: per-process JSONL that `diag` replays "
+        "after the run. `session/opsplane.py` (ISSUE 13) gives a "
+        "running multi-tier session ONE live view: every tier (gateway "
+        "serve loop, fleet replicas, experience shards, parameter "
+        "fanout, learner) pushes its gauge/hop rows over its OWN "
+        "cadence-bounded PUSH socket (zmq sockets are not thread-safe; "
+        "process tiers inherit the address through spawn kwargs like "
+        "the trace id), and the learner-side aggregator merges the "
+        "latest row per tier into a trace-id-stamped snapshot at the "
+        "metrics cadence — atomically replaced on disk, rendered live "
+        "by `surreal_tpu top <folder>`. Declared `session.slo.*` "
+        "objectives (act RTT p99, attach p99, per-tenant throttle "
+        "rate, parameter staleness) are evaluated per snapshot window "
+        "with rolling error budgets: every breached window is a "
+        "counted `slo_breach` event, and a budget exhaustion — like a "
+        "recovery trip or a chaos fault — dumps the flight recorder's "
+        "bounded ring of pre-incident snapshots + fault events to "
+        "`telemetry/flightrec/<trigger>/`. A tier silent for 3x its "
+        "own declared cadence renders DEAD, never silently fine.",
+    ]
+    ops = _load_ops_bench()
+    if ops:
+        snap = ops.get("snapshot_ms") or {}
+        push = ops.get("push_ms") or {}
+        lines += [
+            "",
+            f"Measured at a production tier census "
+            f"({ops.get('workload', 'benchmark workload')}; "
+            f"`BENCH_ops.json`, platform `{ops.get('platform')}`):",
+            "",
+            "| Cost | p50 ms | p99 ms |",
+            "|---|---|---|",
+        ]
+        for name, row in (
+            ("snapshot build (merge + SLO eval + atomic write)", snap),
+            ("tier push (serve-loop side, one row)", push),
+        ):
+            if not row:
+                continue
+            p50, p99 = row.get("p50"), row.get("p99")
+            lines.append(
+                "| {n} | {a} | {b} |".format(
+                    n=name,
+                    a=f"{float(p50):.4f}" if p50 is not None else "n/a",
+                    b=f"{float(p99):.4f}" if p99 is not None else "n/a",
+                )
+            )
+        frac = ops.get("snapshot_frac_of_iter")
+        iter_ms = ops.get("iter_ms")
+        lines += [
+            "",
+            "Overhead commitment: the whole snapshot path is pure host "
+            "python (the transfer-guard suite runs it under "
+            "`disallow_device_to_host` — zero device syncs added)"
+            + (
+                f", and one snapshot costs {float(frac):.2%} of the "
+                f"{float(iter_ms):.0f} ms steady-state iteration at the "
+                "committed headline geometry (commitment <= "
+                f"{float(ops.get('snapshot_frac_max', 0.05)):.0%}"
+                if frac is not None and iter_ms is not None else "("
+            )
+            + "); a tier push is non-blocking with a small HWM — a full "
+            "queue drops the row, counted, never stalls a serve loop. "
+            "Both gated by `perf_gate.gate_ops`, folded into `gate()`.",
+        ]
+    return lines
+
+
 def _load_tune_bench():
     """Load the autotuner artifact (``BENCH_tune.json``, written by
     ``surreal_tpu tune ... --out BENCH_tune.json``) if present — like
@@ -1922,6 +2015,7 @@ def main(argv=None) -> None:
     lines += _experience_plane_lines()
     lines += _act_path_lines()
     lines += _gateway_lines()
+    lines += _ops_plane_lines()
     if scaling:
         lines += [
             "",

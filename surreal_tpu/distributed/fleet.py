@@ -97,6 +97,8 @@ class InferenceFleet:
         respawn_backoff_s: float = 0.5,
         respawn_backoff_cap_s: float = 30.0,
         act_history: int = 8,
+        ops_address: str | None = None,
+        ops_interval_s: float = 1.0,
     ):
         if replicas < 1:
             raise ValueError(f"inference_fleet.replicas must be >= 1, got {replicas}")
@@ -128,6 +130,8 @@ class InferenceFleet:
             sanitize_obs=sanitize_obs,
             trace_id=trace_id,
             chunks=self.chunks,
+            ops_address=ops_address,
+            ops_interval_s=ops_interval_s,
         )
         self.min_replicas = max(1, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
@@ -167,6 +171,9 @@ class InferenceFleet:
             bind=self._addresses[i],
             min_batch=1,  # _rebalance_budgets installs the affinity share
             version=self._version,
+            # per-slot ops tier name: a respawn keeps the slot's identity,
+            # so the aggregator sees one row turn DEAD and come back
+            ops_tier=f"fleet.replica{i}",
             **self._server_kwargs,
         )
 

@@ -104,6 +104,9 @@ class InferenceServer:
         trace_id: str | None = None,
         version: int = 0,
         chunks: "queue.Queue[dict] | None" = None,
+        ops_address: str | None = None,
+        ops_tier: str = "fleet.replica0",
+        ops_interval_s: float = 1.0,
     ):
         # version: starting params version. The fleet supervisor
         # (distributed/fleet.py) respawns a crashed replica with the
@@ -163,6 +166,13 @@ class InferenceServer:
         # success metric (pickle ships the arrays; shm ships ~30 B frames)
         self._wire_bytes = 0
         self._served_steps = 0
+        # ops plane (ISSUE 13): each replica's serve loop pushes its own
+        # gauge/hop row to the run aggregator over its OWN PUSH socket
+        # (zmq sockets are not thread-safe), cadence-bounded — per-replica
+        # liveness falls out of the aggregator's row-age DEAD rule
+        self._ops_address = ops_address
+        self._ops_tier = str(ops_tier)
+        self._ops_interval_s = float(ops_interval_s)
 
         # rolling completed-episode stats shipped by workers (SURVEY.md
         # §5.5); read via episode_stats(). Window matches the host
@@ -219,17 +229,31 @@ class InferenceServer:
         # serve thread dies from an exception (incl. the kill_replica
         # chaos injection) must release its bound ROUTER socket, or the
         # supervisor's in-place respawn could never rebind the address
+        ops = None
+        if self._ops_address:
+            from surreal_tpu.session.opsplane import OpsPusher
+
+            ops = OpsPusher(
+                self._ops_address,
+                self._ops_tier,
+                trace_id=self.trace_id,
+                min_interval_s=self._ops_interval_s,
+            )
         try:
-            self._loop_body()
+            self._loop_body(ops)
         finally:
+            if ops is not None:
+                ops.close()
             self._sock.close(0)
 
-    def _loop_body(self) -> None:
+    def _loop_body(self, ops=None) -> None:
         poller = zmq.Poller()
         poller.register(self._sock, zmq.POLLIN)
         pending: list[tuple[bytes, dict]] = []
         deadline: float | None = None
         while not self._stop.is_set():
+            if ops is not None:
+                ops.push(gauges=self.queue_stats(), hops=self.hop_stats())
             f = faults.fire("fleet.replica")
             if f is not None:
                 if f["kind"] == "kill_replica":

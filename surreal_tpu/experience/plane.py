@@ -52,6 +52,7 @@ class ExperiencePlane:
         trace_id: str | None = None,
         prefetch: bool = True,
         device_put: bool = True,
+        ops_address: str | None = None,
     ):
         cfg = dict(cfg or {})
         self.kind = kind
@@ -64,6 +65,10 @@ class ExperiencePlane:
             )
         self.transport = cfg.get("transport", "auto")
         self.trace_id = trace_id
+        # ops plane (ISSUE 13): shards push their own rows; process shards
+        # inherit the aggregator address via spawn kwargs (the trace-id /
+        # fault-plan rule). The address survives respawns unchanged.
+        self.ops_address = ops_address
         self.start_sample_size = int(start_sample_size)
         self._backoff_base = float(cfg.get("respawn_backoff_s", 0.5))
         self._backoff_cap = float(cfg.get("respawn_backoff_cap_s", 30.0))
@@ -141,7 +146,9 @@ class ExperiencePlane:
 
     # -- lifecycle -----------------------------------------------------------
     def _spawn_shard(self, i: int):
-        kwargs: dict[str, Any] = dict(trace_id=self.trace_id)
+        kwargs: dict[str, Any] = dict(
+            trace_id=self.trace_id, ops_address=self.ops_address
+        )
         if self.shard_mode == "process":
             import multiprocessing as mp
 

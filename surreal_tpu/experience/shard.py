@@ -293,6 +293,7 @@ def run_shard_server(
     force_cpu: bool = False,
     threefry_partitionable: bool | None = None,
     untrack_slabs: bool = False,
+    ops_address: str | None = None,
 ) -> int:
     """Serve one replay shard until ``stop_event`` (thread mode) or
     process death. Returns rows ingested.
@@ -588,9 +589,42 @@ def run_shard_server(
                 still.append((ident, req, arrived))
         deferred[:] = still
 
+    # ops plane (ISSUE 13): each shard pushes its own gauge row to the
+    # run aggregator — its OWN PUSH socket in this serve loop (zmq
+    # sockets are not thread-safe), cadence-bounded by the pusher.
+    # Process shards inherit ``ops_address`` via spawn kwargs, exactly
+    # like the fault plan and the trace id.
+    ops = None
+    if ops_address:
+        from surreal_tpu.session.opsplane import OpsPusher
+
+        ops = OpsPusher(
+            ops_address, f"experience.shard{shard_id}", trace_id=trace_id
+        )
+
+    def ops_push() -> None:
+        if ops is None:
+            return
+        gauges = {
+            k: v for k, v in stats.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        gauges["ingested_rows"] = ingested_rows
+        gauges["sample_queue_depth"] = len(deferred)
+        if ring is not None:
+            gauges.update(ring.gauges())
+        from surreal_tpu.session.telemetry import latency_percentiles
+
+        p = latency_percentiles(transit_ms)
+        ops.push(
+            gauges=gauges,
+            hops={"ingest_transit_ms": p} if p is not None else None,
+        )
+
     try:
         sock.bind(bind_address)
         while not (stop_event is not None and stop_event.is_set()):
+            ops_push()
             f = faults.fire("experience.shard")
             if f is not None:
                 if f["kind"] == "kill_shard":
@@ -615,6 +649,8 @@ def run_shard_server(
         # client never attached (its hello attempt timed out) has no
         # other reaper; a client that DID attach unlinks too, which
         # unlink_slab tolerates (ENOENT is a no-op).
+        if ops is not None:
+            ops.close()
         graceful = stop_event is not None and stop_event.is_set()
         for peer in peers.values():
             peer.views = []

@@ -26,11 +26,15 @@ Frames (single ZMQ frames after the DEALER ident):
   metadata), transport, optional version pin, trace id.
 - **GHELLO_OK / GHELLO_NO** (JSON): granted session id + lease + resume
   token, or the counted rejection reason (quota, capacity).
-- **ACT**: struct header (session id, seq, flags, t_send) + raw obs
-  bytes. ``seq`` makes the bounded client resend idempotent-enough: a
-  reply lost to chaos (``gateway.session`` ``drop_frame``) is simply
+- **ACT**: struct header (session id, seq, span, flags, t_send) + raw
+  obs bytes. ``seq`` makes the bounded client resend idempotent-enough:
+  a reply lost to chaos (``gateway.session`` ``drop_frame``) is simply
   re-served — acting twice on the same obs is harmless, losing the
-  session is not.
+  session is not. ``span``/``t_send`` join the act path to the PR-6 hop
+  telemetry (tenant->gateway transit percentiles), stamped under the
+  same local-address guard as STEP frames: one-host transports share a
+  clock, cross-host ones would fabricate latency from clock skew, so a
+  non-local client stamps ``t_send=0`` and the server skips the sample.
 - **ACT_OK**: struct header (seq, served param version, flags, action
   meta length, t_send) + JSON action meta (shape/dtype) + raw action
   bytes. The served VERSION rides every reply — a pin that had to be
@@ -77,7 +81,9 @@ PMSG = 10
 # a fixed struct — no per-frame length fields on the hot path
 SID_BYTES = 16
 
-_ACT_HDR = struct.Struct(f"<{SID_BYTES}sIBd")   # sid, seq, flags, t_send
+# sid, seq, span, flags, t_send — both wire ends live in this repo, so
+# the header can grow a field (span) without a version dance
+_ACT_HDR = struct.Struct(f"<{SID_BYTES}sIIBd")
 _ACTOK_HDR = struct.Struct("<IQBHd")  # seq, version, flags, meta_len, t_send
 
 # ACT_OK flags
@@ -137,13 +143,13 @@ def encode_hello_no(reason: str) -> bytes:
 
 
 def encode_act(session: str, seq: int, obs: np.ndarray,
-               t_send: float = 0.0) -> bytes:
+               span: int = 0, t_send: float = 0.0) -> bytes:
     sid = session.encode()
     if len(sid) != SID_BYTES:
         raise ValueError(f"session id must be {SID_BYTES} bytes, got {sid!r}")
     return (
         MAGIC + bytes([ACT])
-        + _ACT_HDR.pack(sid, seq & 0xFFFFFFFF, 0, t_send)
+        + _ACT_HDR.pack(sid, seq & 0xFFFFFFFF, span & 0xFFFFFFFF, 0, t_send)
         + np.ascontiguousarray(obs).tobytes()
     )
 
@@ -210,10 +216,11 @@ def decode_payload(payload: bytes) -> tuple[str, Any]:
         }[kind]
         return name, json.loads(bytes(body).decode())
     if kind == ACT:
-        sid, seq, flags, t_send = _ACT_HDR.unpack_from(body, 0)
+        sid, seq, span, flags, t_send = _ACT_HDR.unpack_from(body, 0)
         return "act", {
-            "session": sid.decode(), "seq": seq, "flags": flags,
-            "t_send": t_send, "body": body[_ACT_HDR.size:],
+            "session": sid.decode(), "seq": seq, "span": span,
+            "flags": flags, "t_send": t_send,
+            "body": body[_ACT_HDR.size:],
         }
     if kind == ACT_OK:
         seq, version, flags, meta_len, t_send = _ACTOK_HDR.unpack_from(
@@ -307,6 +314,14 @@ class GatewaySession:
         self._sock.setsockopt(zmq.LINGER, 0)
         self._sock.connect(address)
         self._address = address
+        # the PR-6 STEP-frame rule: t_send only means something when both
+        # ends share a clock, so stamping is gated on a local transport —
+        # a cross-host session sends t_send=0 and the server records no
+        # transit sample (skew must not masquerade as latency)
+        from surreal_tpu.distributed.shm_transport import local_address
+
+        self._stamp_clock = local_address(address)
+        self._span = 0
         self.session: str | None = None
         # the resume credential from GHELLO_OK: pass it (with the
         # session id) to a new GatewaySession to re-attach after churn
@@ -355,13 +370,17 @@ class GatewaySession:
         obs = np.ascontiguousarray(obs, self.obs_dtype)
         self._seq += 1
         seq = self._seq
+        self._span += 1
+        t_send = time.time() if self._stamp_clock else 0.0
         if self.transport == "pickle":
             frame = encode_pickle_act(self.session, {
-                "kind": "act", "seq": seq, "obs": obs,
-                "t_send": time.time(),
+                "kind": "act", "seq": seq, "span": self._span,
+                "obs": obs, "t_send": t_send,
             })
         else:
-            frame = encode_act(self.session, seq, obs, t_send=time.time())
+            frame = encode_act(
+                self.session, seq, obs, span=self._span, t_send=t_send
+            )
         per_try = self.timeout_s / self.retries
         for attempt in range(self.retries):
             if attempt:

@@ -91,6 +91,8 @@ class GatewayServer:
         trace_id: str | None = None,
         respawn_backoff_s: float = 0.5,
         respawn_backoff_cap_s: float = 30.0,
+        ops_address: str | None = None,
+        ops_interval_s: float = 1.0,
     ):
         self.fleet = fleet
         self.address = bind or alloc_address()
@@ -128,7 +130,20 @@ class GatewayServer:
         # act round-trip serve time (recv -> reply), rolling window —
         # the diag/bench latency story server-side
         self._hop_act: "deque[float]" = deque(maxlen=512)
+        # tenant->gateway wire transit (ACT t_send -> recv; only frames
+        # whose client passed the local-address clock guard) and attach
+        # handling time — the act path's entries in the hops story
+        self._hop_transit: "deque[float]" = deque(maxlen=512)
+        self._hop_attach: "deque[float]" = deque(maxlen=512)
         self._drop_next_reply = 0
+        # per-tenant served-act counters (tenant_stats / SLO throttle
+        # rate: throttled vs served deltas per window)
+        self._tenant_acts: dict[str, int] = {}
+        # ops plane (ISSUE 13): the serve loop pushes its gauge/hop/event
+        # rows to the run aggregator over its OWN socket (zmq sockets are
+        # not thread-safe), cadence-bounded
+        self._ops_address = ops_address
+        self._ops_interval_s = float(ops_interval_s)
         self._last_replica: int | None = None
         self._sched = RespawnSchedule(
             1, respawn_backoff_s, respawn_backoff_cap_s
@@ -176,15 +191,32 @@ class GatewayServer:
         sock = self._ctx.socket(zmq.ROUTER)
         sock.setsockopt(zmq.ROUTER_HANDOVER, 1)
         sock.bind(self.address)
+        ops = None
+        if self._ops_address is not None:
+            from surreal_tpu.session.opsplane import OpsPusher
+
+            # created (and closed) in the serve thread: the pusher's
+            # socket belongs to this thread alone
+            ops = OpsPusher(
+                self._ops_address, "gateway", trace_id=self.trace_id,
+                min_interval_s=self._ops_interval_s,
+            )
         try:
-            self._loop_body(sock)
+            self._loop_body(sock, ops)
         finally:
+            if ops is not None:
+                ops.close()
             sock.close(0)
 
-    def _loop_body(self, sock) -> None:
+    def _loop_body(self, sock, ops=None) -> None:
         poller = zmq.Poller()
         poller.register(sock, zmq.POLLIN)
         while not self._stop.is_set():
+            if ops is not None:
+                ops.push(
+                    gauges=self.gauges(), hops=self.hop_stats(),
+                    body=self.event(),
+                )
             f = faults.fire("gateway.session")
             if f is not None:
                 self._apply_fault(f)
@@ -228,9 +260,12 @@ class GatewayServer:
             self.bad_frames += 1
             return
         if kind == "hello":
+            t0 = time.monotonic()
             self._handle_hello(sock, ident, obj)
+            self._hop_attach.append((time.monotonic() - t0) * 1e3)
         elif kind == "act":
             sid = obj["session"]
+            self._note_transit(obj.get("t_send", 0.0))
             try:
                 obs = self._act_obs(obj)
             except ValueError as e:
@@ -285,6 +320,7 @@ class GatewayServer:
                 raise ValueError("fallback frame is not an act dict")
             seq = int(msg["seq"])
             obs = np.asarray(msg["obs"])
+            self._note_transit(float(msg.get("t_send", 0.0)))
         except Exception:
             # corrupt/hostile fallback body: counted + answered; the
             # session (and the tier) survive the frame
@@ -331,6 +367,14 @@ class GatewayServer:
                 slot,
                 lambda sid: self.fleet.replica_of(zlib.crc32(sid.encode())),
             )
+
+    def _note_transit(self, t_send: float) -> None:
+        """Record tenant->gateway wire transit for one ACT frame. A
+        client outside the local-address clock guard stamps t_send=0 —
+        no sample (clock skew must not masquerade as latency), same rule
+        as the PR-6 STEP frames."""
+        if t_send and t_send > 0:
+            self._hop_transit.append(max(0.0, (time.time() - t_send) * 1e3))
 
     # -- frame handlers ------------------------------------------------------
     def _reply(self, sock, ident: bytes, payload: bytes) -> None:
@@ -572,6 +616,7 @@ class GatewayServer:
                     t0) -> None:
         self.table.touch(rec.session, seq=seq)
         self.acts += 1
+        self._tenant_acts[rec.tenant] = self._tenant_acts.get(rec.tenant, 0) + 1
         self._last_replica = rec.replica
         self._hop_act.append((time.monotonic() - t0) * 1e3)
         self._reply(sock, ident, gw.encode_act_ok(
@@ -632,8 +677,16 @@ class GatewayServer:
     def hop_stats(self) -> dict[str, dict]:
         from surreal_tpu.session.telemetry import latency_percentiles
 
-        p = latency_percentiles(list(self._hop_act))
-        return {"gateway_act_ms": p} if p is not None else {}
+        out = {}
+        for name, window in (
+            ("gateway_act_ms", self._hop_act),
+            ("gateway_transit_ms", self._hop_transit),
+            ("gateway_attach_ms", self._hop_attach),
+        ):
+            p = latency_percentiles(list(window))
+            if p is not None:
+                out[name] = p
+        return out
 
     def tenant_stats(self) -> dict[str, dict]:
         """Per-tenant table for diag's Gateway section."""
@@ -644,6 +697,7 @@ class GatewayServer:
                 "sessions": counts.get(name, 0),
                 "max_sessions": t.max_sessions,
                 "rate": t.bucket.rate,
+                "acts": self._tenant_acts.get(name, 0),
                 "queued": len(t.queue),
                 "throttled": t.throttled,
                 "evicted": t.evicted,
@@ -651,7 +705,9 @@ class GatewayServer:
             }
         for name, n in counts.items():
             if name not in out:
-                out[name] = {"sessions": n}
+                out[name] = {
+                    "sessions": n, "acts": self._tenant_acts.get(name, 0)
+                }
         return out
 
     def event(self) -> dict:

@@ -29,6 +29,7 @@ from surreal_tpu.session.config import Config
 from surreal_tpu.session.costs import CostAccountant
 from surreal_tpu.session.interrupt import InterruptSentinel
 from surreal_tpu.session.metrics import get_logger, make_metrics_writer
+from surreal_tpu.session.opsplane import OpsAggregator
 from surreal_tpu.session.profile import ProfileManager
 from surreal_tpu.session.telemetry import Tracer
 from surreal_tpu.session.tracker import PeriodicTracker
@@ -96,6 +97,9 @@ class SessionHooks:
             cfg.folder,
             enabled=bool(tel.enabled) if tel is not None else True,
             name=name,
+            # size-based JSONL rotation (ISSUE 13 satellite): a week-long
+            # run must not grow events.jsonl without bound
+            max_log_mb=tel.get("max_log_mb", None) if tel is not None else None,
         )
         # cross-process trace correlation: the run-scoped trace id every
         # telemetry event carries; spawned env workers / the inference
@@ -142,6 +146,16 @@ class SessionHooks:
             enabled=bool(rec.get("interrupt", True)) if rec is not None else True
         )
         self.recovery = RecoveryManager(config, self.ckpt, self.tracer, self.log)
+        # live ops plane (ISSUE 13): the run-scoped cross-tier aggregator.
+        # Wire tiers (gateway, fleet replicas, experience shards) push
+        # into ``ops.address`` — process tiers inherit it through spawn
+        # kwargs like the trace id; learner-thread tiers land through
+        # push_local below. ``snapshot()`` rides the metrics cadence.
+        self.ops = OpsAggregator(
+            cfg.folder, trace_id=self.trace_id,
+            cfg=cfg.get("ops", None), slo_cfg=cfg.get("slo", None),
+            on_event=self.tracer.event,
+        )
         self._interrupt_logged = False
         # optional step-aligned auxiliary state (the off-policy trainer
         # sets this to snapshot its replay buffer when
@@ -263,6 +277,9 @@ class SessionHooks:
         ``serving_tier`` event per metrics row — ``surreal_tpu diag``'s
         "Serving tier" section renders the last one."""
         self.tracer.event("serving_tier", **info)
+        # the merged fleet view is a learner-thread tier: no wire hop.
+        # (per-replica liveness rides each replica's OWN wire row.)
+        self.ops.push_local("fleet", body=info)
 
     def gateway_event(self, **info) -> None:
         """Record the session gateway's tenant-facing snapshot (sessions,
@@ -278,6 +295,7 @@ class SessionHooks:
         ``surreal_tpu diag``'s "Experience plane" section renders the
         last one plus the per-hop sender->shard->learner percentiles."""
         self.tracer.event("experience_plane", **info)
+        self.ops.push_local("experience", body=info)
 
     def record_program_costs(
         self, name: str, jitted, *args,
@@ -464,6 +482,14 @@ class SessionHooks:
             # trip sets recovery.pending, which the DRIVER resolves via
             # rollback()
             trip_reason = self.recovery.check(m, iteration, env_steps)
+            if trip_reason is not None:
+                # incident: freeze the minutes BEFORE the trip (the
+                # flight recorder's ring) next to the trip itself
+                self.ops.record_recovery({
+                    "reason": str(trip_reason),
+                    "iteration": int(iteration), "env_steps": int(env_steps),
+                })
+                self.ops.dump("recovery")
         # skip the state-consuming side-bands while the guard is tripped in
         # BOTH rollback and warn modes (warn is the multi-host setting — a
         # poisoned save would make auto_resume restore the poison).
@@ -489,6 +515,18 @@ class SessionHooks:
                     # publisher/server blob above stays the fetch
                     # fallback for late joiners
                     self._fanout.publish(view)
+            # ops plane: the fanout tier's row — its published version vs
+            # the fleet replicas' held versions is the staleness derivation
+            self.ops.push_local(
+                "param_fanout",
+                gauges={
+                    "version": float(version),
+                    **(
+                        self._fanout.gauges()
+                        if self._fanout is not None else {}
+                    ),
+                },
+            )
             if m is not None:
                 m["publish/version"] = float(version)
                 if self._fanout is not None:
@@ -515,6 +553,19 @@ class SessionHooks:
             # beyond the metrics already synced above (transfer-guard
             # tested in tests/test_telemetry.py)
             m.update(self.costs.gauges(self.tracer.last_window))
+            # ops plane: the learner's own row, then the merged run
+            # snapshot — pure host float/dict work on rows the tiers
+            # already pushed, zero device->host syncs beyond the metrics
+            # synced above (the same transfer-guard covers it)
+            self.ops.push_local(
+                "learner",
+                gauges={
+                    k: v for k, v in m.items()
+                    if isinstance(v, (int, float))
+                },
+            )
+            self.ops.snapshot(int(iteration), int(env_steps))
+            m.update(self.ops.gauges())
             self._last_train = m
         if m or evaled:
             self.writer.write(env_steps, {**(m or {}), **evaled})
@@ -539,9 +590,15 @@ class SessionHooks:
                         self.ckpt.save_extra(iteration, self.extra_state_fn())
         self.profile.tick(iteration)
         # chaos-harness visibility: mirror any faults fired since the last
-        # boundary into the telemetry spine (empty list in normal runs)
-        for ev in faults.drain_fired():
+        # boundary into the telemetry spine (empty list in normal runs) —
+        # and into the flight recorder, whose dump freezes the snapshots
+        # leading up to the incident
+        fired = faults.drain_fired()
+        for ev in fired:
             self.tracer.event("fault", **ev)
+            self.ops.record_fault(ev)
+        if fired:
+            self.ops.dump("fault")
         stop = m is not None and on_metrics is not None and bool(
             on_metrics(iteration, m)
         )
@@ -615,6 +672,11 @@ class SessionHooks:
         self.interrupt.close()  # restore the process's previous handlers
         for ev in faults.drain_fired():  # tail faults since the last boundary
             self.tracer.event("fault", **ev)
+            self.ops.record_fault(ev)
+        # stop the ops receiver BEFORE the tiers that push into it come
+        # down (a pushed row into a closed PULL is just dropped, but the
+        # join here keeps thread teardown deterministic)
+        self.ops.close()
         self.profile.close()  # stop + record a capture cut short by exit
         if self._param_server is not None:
             self._param_server.close()

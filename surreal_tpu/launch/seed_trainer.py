@@ -42,12 +42,14 @@ from surreal_tpu.utils import faults
 _FROM_CONFIG = object()  # sentinel: None is a meaningful max_staleness value
 
 
-def hop_event(server, plane, learn_ms) -> dict:
+def hop_event(server, plane, learn_ms, gateway=None) -> dict:
     """Assemble the per-hop latency percentiles for one ``hops``
     telemetry event — the stitched cross-process timeline (worker step ->
     frame in flight -> serve batch -> queue dwell -> learn), rendered by
     ``surreal_tpu diag``. The learn hop measures DISPATCH time (the span
-    discipline of session/telemetry.py), named accordingly."""
+    discipline of session/telemetry.py), named accordingly. A live
+    gateway joins with its act/transit/attach windows (ISSUE 13: GACT
+    frames stamp t_send under the local-address clock guard)."""
     from surreal_tpu.session.telemetry import latency_percentiles
 
     hops = dict(server.hop_stats())
@@ -57,6 +59,8 @@ def hop_event(server, plane, learn_ms) -> dict:
     p = latency_percentiles(list(learn_ms))
     if p is not None:
         hops["learn_dispatch_ms"] = p
+    if gateway is not None:
+        hops.update(gateway.hop_stats())
     return hops
 
 
@@ -274,6 +278,10 @@ class SEEDTrainer:
         # before the data plane spawns, so every worker (thread or
         # process) inherits the run-scoped trace id via spawn kwargs
         self._trace_id: str | None = None
+        # ops plane (ISSUE 13): run() sets this from hooks before the
+        # data plane spawns; every wire tier (fleet replicas, experience
+        # shards, gateway) inherits the aggregator address the same way
+        self._ops_address: str | None = None
         n_envs = int(config.env_config.num_envs)
         # pipelined sub-slices halve the per-chunk batch width, so the
         # learn program compiles once per width: keep widths uniform (even
@@ -410,6 +418,8 @@ class SEEDTrainer:
             # poisoning the whole micro-batch. `.get` keeps old configs
             # loadable.
             sanitize_obs=bool(topo.get("sanitize_obs", True)),
+            # ops plane: replicas push their own rows to the aggregator
+            ops_address=self._ops_address,
         )
         # serving tier (ISSUE 10, distributed/fleet.py): >1 replica (or
         # autoscale on) runs the replicated fleet with session-affinity
@@ -531,6 +541,7 @@ class SEEDTrainer:
             key_holder = [act_key]
             # workers inherit the run-scoped trace id via spawn kwargs
             self._trace_id = hooks.trace_id
+            self._ops_address = hooks.ops.address
             # the FIRST chunk waits out the policy's XLA compiles plus a
             # full unroll of round trips (can be minutes on a tunneled
             # TPU); workers keep their own 120s liveness budget per step,
@@ -588,6 +599,7 @@ class SEEDTrainer:
                     respawn_backoff_cap_s=float(
                         gw_cfg.get("respawn_backoff_cap_s", 30.0)
                     ),
+                    ops_address=hooks.ops.address,
                 )
                 self._gateway = gateway  # exposed for tests
                 hooks.log.info("session gateway live at %s", gateway.address)
@@ -605,6 +617,7 @@ class SEEDTrainer:
                     kind="fifo",
                     cfg=topo.get("experience_plane", None),
                     trace_id=hooks.trace_id,
+                    ops_address=hooks.ops.address,
                 )
 
                 def relay_chunks():
@@ -783,7 +796,7 @@ class SEEDTrainer:
                     # per-hop latency percentiles ride the metrics cadence
                     # (host-side deques only — no device work)
                     hooks.tracer.event(
-                        "hops", **hop_event(server, plane, learn_ms)
+                        "hops", **hop_event(server, plane, learn_ms, gateway)
                     )
                     if hasattr(server, "maybe_autoscale"):
                         # serving tier: one scale decision per cadence

@@ -42,6 +42,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from surreal_tpu.session.config import Config
 from surreal_tpu.session.default_configs import base_config
@@ -837,6 +838,34 @@ def run_diag(args) -> int:
     return 0
 
 
+def run_top(args) -> int:
+    """Live cross-tier ops view from the aggregator's merged snapshot
+    file (session/opsplane.py): per-tier health, per-tenant SLO/budget
+    table, hop latencies, MFU. Pure file reading — no jax, no zmq — so
+    it runs off-chip against a LIVE run, refreshing at ``--interval``
+    until interrupted (or printing once with ``--once``)."""
+    from surreal_tpu.session.opsplane import load_snapshot, top_report
+
+    if not os.path.isdir(args.folder):
+        print(f"no session folder {args.folder!r}", file=sys.stderr)
+        return 2
+    if args.once:
+        snap = load_snapshot(args.folder)
+        print(top_report(snap, args.folder))
+        return 0 if snap is not None else 2
+    try:
+        while True:
+            report = top_report(load_snapshot(args.folder), args.folder)
+            # clear-screen + home, like top(1); falls back to plain
+            # scrolling output when stdout is not a terminal
+            if sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            print(report, flush=True)
+            time.sleep(max(0.2, float(args.interval)))
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="surreal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -972,6 +1001,17 @@ def main(argv=None) -> int:
                    help="print the aggregated summary as one JSON object "
                         "instead of the human-readable report")
     d.set_defaults(fn=run_diag)
+
+    tp = sub.add_parser("top", help="live cross-tier ops view from the "
+                        "run's merged snapshot (telemetry/"
+                        "ops_snapshot.json): tier health, per-tenant "
+                        "SLO/error-budget table, hop latencies, MFU")
+    tp.add_argument("folder", help="the live session's folder")
+    tp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (scripts/tests)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    tp.set_defaults(fn=run_top)
 
     args = parser.parse_args(argv)
     # the --local-procs supervisor re-issues this exact command per rank

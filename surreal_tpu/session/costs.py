@@ -186,6 +186,34 @@ GAUGE_REGISTRY = {
     ),
     "gateway/expired_leases": "sessions reaped idle past their lease.",
     "gateway/queued_acts": "acts currently parked across tenant queues.",
+    # -- live ops plane (session/opsplane.py; ISSUE 13) ---------------------
+    "ops/tiers": (
+        "tiers that have pushed at least one row to the run aggregator "
+        "(gateway, fleet replicas, experience shards, learner, fanout)."
+    ),
+    "ops/bad_frames": (
+        "undecodable/hostile rows dropped at the aggregator's PULL "
+        "boundary — counted, never a crash."
+    ),
+    "ops/snapshots": (
+        "merged run snapshots written to telemetry/ops_snapshot.json "
+        "(one per metrics cadence; the file `surreal_tpu top` renders)."
+    ),
+    "ops/flightrec_dumps": (
+        "flight-recorder dumps written under telemetry/flightrec/ "
+        "(recovery trip, chaos fault, or SLO budget exhaustion; at most "
+        "one per trigger per cooldown)."
+    ),
+    # per-tenant SLOs (session/slo.py)
+    "slo/breaches": (
+        "SLO evaluation windows that breached a declared objective "
+        "(every one is also a counted slo_breach telemetry event)."
+    ),
+    "slo/exhaustions": (
+        "error budgets exhausted this run (edge-triggered: one per "
+        "incident, each freezing a flightrec/slo dump)."
+    ),
+    "slo/objectives": "objectives armed via session_config.slo.* targets.",
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

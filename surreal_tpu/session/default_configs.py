@@ -391,6 +391,41 @@ BASE_SESSION_CONFIG = Config(
         # telemetry/heartbeat_rank<k>.jsonl at this cadence (seconds);
         # ranks whose host cannot write the folder disable silently
         heartbeat_every_s=10.0,
+        # size-based rotation for events.jsonl: past this size the log is
+        # renamed to events.jsonl.1 (one rotated segment kept; an older
+        # .1 is overwritten) and a fresh file starts — diag and the
+        # _iter_jsonl readers stitch .1 + current in order. None = never
+        # rotate (the pre-PR-13 behavior).
+        max_log_mb=256,
+    ),
+    # live ops plane (ISSUE 13, session/opsplane.py): every tier pushes
+    # its gauge/hop row to a run-scoped aggregator; at the metrics cadence
+    # the learner merges them into telemetry/ops_snapshot.json (the file
+    # `surreal_tpu top <folder>` renders) and feeds the flight recorder —
+    # a bounded ring of the last `ring` snapshots + fault/recovery events,
+    # dumped to telemetry/flightrec/<trigger>/ when the recovery guard
+    # trips, a chaos fault fires, or an SLO error budget exhausts (at most
+    # one dump per trigger per min_dump_interval_s).
+    ops=Config(
+        enabled=True,
+        ring=64,
+        min_dump_interval_s=5.0,
+    ),
+    # per-tenant SLOs (session/slo.py), evaluated per metrics window
+    # against the gateway's per-tenant stats + the merged hop percentiles.
+    # Objectives default to None = not declared (no noise in normal runs);
+    # set a target to arm one. `budget` is the tolerated breach fraction
+    # over a rolling `budget_windows` evaluation windows — exhausting it
+    # emits a counted slo_breach with exhausted=True and freezes a flight
+    # recorder dump under flightrec/slo/.
+    slo=Config(
+        enabled=True,
+        budget_windows=20,
+        budget=0.2,
+        act_rtt_p99_ms=None,      # gateway act round-trip p99 (ms)
+        attach_p99_ms=None,       # session attach/hello latency p99 (ms)
+        throttle_rate=None,       # throttled / (throttled + served) per window
+        staleness_updates=None,   # published version - oldest replica version
     ),
     eval=Config(
         every_n_iters=100,

@@ -1,0 +1,169 @@
+"""Per-tenant SLO objectives with rolling error budgets (ISSUE 13).
+
+The gateway made the run multi-tenant (PR 12); this module makes the
+tenants' experience *contractual*: declared ``session_config.slo.*``
+objectives are evaluated once per ops-plane snapshot window against the
+gateway's live per-tenant stats and hop percentiles, each (tenant,
+objective) pair carries a rolling error budget over the last
+``budget_windows`` evaluations, and every breach is a counted, never-
+silent ``slo_breach`` telemetry event. Budget *exhaustion* is
+edge-triggered back to the caller (the OpsAggregator) so it can dump the
+flight recorder exactly once per incident, not once per window.
+
+Objectives (a ``None`` target disables that objective — the default, so
+an unconfigured run evaluates nothing and emits nothing):
+
+    act_rtt_p99_ms     gateway act serve p99 (``gateway_act_ms`` hop)
+    attach_p99_ms      session attach/hello p99 (``gateway_attach_ms``)
+    throttle_rate      per-tenant fraction of acts throttled this window
+                       (counter deltas: throttled / (throttled + acts))
+    staleness_updates  published-vs-pinned parameter-version lag
+                       (run-wide, derived by the aggregator)
+
+Latency objectives are gateway-wide measurements applied to every tenant
+attached in the window (the gateway serves all tenants from one loop, so
+per-tenant latency IS the loop's latency); ``throttle_rate`` is truly
+per-tenant. Pure host python — no jax, no device syncs (the transfer
+guard covers the whole snapshot path).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+# objective name -> config key (identical today; the indirection keeps
+# config spelling stable if objective internals are renamed)
+OBJECTIVES = (
+    "act_rtt_p99_ms",
+    "attach_p99_ms",
+    "throttle_rate",
+    "staleness_updates",
+)
+
+
+class SLOTracker:
+    """Rolling per-(tenant, objective) breach windows + error budgets.
+
+    ``evaluate`` is called once per snapshot window by the OpsAggregator;
+    everything else is bookkeeping readable by ``gauges``/``table``.
+    """
+
+    def __init__(self, cfg=None, on_event=None):
+        cfg = cfg or {}
+        get = cfg.get if hasattr(cfg, "get") else lambda k, d=None: d
+        self.enabled = bool(get("enabled", True))
+        # budget: the fraction of the last ``budget_windows`` evaluation
+        # windows allowed to breach before the budget is exhausted
+        self.budget_windows = max(1, int(get("budget_windows", 20)))
+        self.budget = float(get("budget", 0.2))
+        self.targets = {
+            name: get(name, None)
+            for name in OBJECTIVES
+            if get(name, None) is not None
+        }
+        self._on_event = on_event
+        # (tenant, objective) -> deque[bool] of per-window breach verdicts
+        self._verdicts: dict[tuple[str, str], deque] = {}
+        # (tenant, objective) pairs whose budget is currently exhausted —
+        # membership edge-triggers the flight-recorder dump
+        self._exhausted: set[tuple[str, str]] = set()
+        # per-tenant previous counter values for window deltas
+        self._prev: dict[str, dict[str, float]] = {}
+        self.breaches = 0
+        self.exhaustions = 0
+
+    @property
+    def active(self) -> bool:
+        return self.enabled and bool(self.targets)
+
+    # -- measurement ---------------------------------------------------------
+    def _measured(self, name: str, tenant: str, stats: dict,
+                  hops: dict, derived: dict):
+        """The window's measured value for one objective, or None when the
+        inputs carry no data (no data is NOT a breach)."""
+        if name == "act_rtt_p99_ms":
+            st = hops.get("gateway_act_ms")
+            return float(st["p99"]) if isinstance(st, dict) else None
+        if name == "attach_p99_ms":
+            st = hops.get("gateway_attach_ms")
+            return float(st["p99"]) if isinstance(st, dict) else None
+        if name == "throttle_rate":
+            prev = self._prev.setdefault(tenant, {})
+            d_thr = float(stats.get("throttled", 0)) - prev.get("throttled", 0.0)
+            d_act = float(stats.get("acts", 0)) - prev.get("acts", 0.0)
+            if d_thr <= 0 and d_act <= 0:
+                return None  # idle tenant this window
+            return d_thr / max(1.0, d_thr + d_act)
+        if name == "staleness_updates":
+            v = derived.get("staleness_updates")
+            return float(v) if v is not None else None
+        return None
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, tenants: dict, hops: dict | None = None,
+                 derived: dict | None = None) -> tuple[dict, list]:
+        """One evaluation window. Returns ``(table, newly_exhausted)``:
+        the snapshot's per-tenant SLO table and the (tenant, objective)
+        pairs whose budget exhausted THIS window (edge-triggered)."""
+        hops = hops or {}
+        derived = derived or {}
+        table: dict[str, dict] = {}
+        newly_exhausted: list[tuple[str, str]] = []
+        if not self.active:
+            return table, newly_exhausted
+        allowed = max(1.0, self.budget * self.budget_windows)
+        for tenant in sorted(tenants or {}):
+            stats = tenants[tenant] or {}
+            row: dict[str, dict] = {}
+            for name, target in self.targets.items():
+                measured = self._measured(name, tenant, stats, hops, derived)
+                if measured is None:
+                    continue
+                breached = measured > float(target)
+                window = self._verdicts.setdefault(
+                    (tenant, name), deque(maxlen=self.budget_windows)
+                )
+                window.append(breached)
+                used = sum(window) / allowed
+                exhausted = used >= 1.0
+                key = (tenant, name)
+                if exhausted and key not in self._exhausted:
+                    self._exhausted.add(key)
+                    self.exhaustions += 1
+                    newly_exhausted.append(key)
+                elif not exhausted:
+                    self._exhausted.discard(key)
+                if breached:
+                    self.breaches += 1
+                    if self._on_event is not None:
+                        # counted, never silent: every breached window is
+                        # one slo_breach event in the telemetry spine
+                        self._on_event(
+                            "slo_breach", tenant=tenant, objective=name,
+                            measured=round(float(measured), 4),
+                            target=float(target),
+                            budget_used=round(used, 3),
+                            exhausted=exhausted,
+                        )
+                row[name] = {
+                    "measured": round(float(measured), 4),
+                    "target": float(target),
+                    "breached": breached,
+                    "budget_used": round(used, 3),
+                    "exhausted": exhausted,
+                }
+            if row:
+                table[tenant] = row
+            # window counter baselines advance regardless of verdicts
+            self._prev[tenant] = {
+                "throttled": float(stats.get("throttled", 0)),
+                "acts": float(stats.get("acts", 0)),
+            }
+        return table, newly_exhausted
+
+    def gauges(self) -> dict[str, float]:
+        return {
+            "slo/breaches": float(self.breaches),
+            "slo/exhaustions": float(self.exhaustions),
+            "slo/objectives": float(len(self.targets)),
+        }

@@ -104,13 +104,30 @@ line, ``t`` = unix seconds):
                      surreal_tpu/experience/, rendered by diag's
                      "Experience plane" section)
     {"type": "gateway", "t": ..., "address": "...", "tenants": {"name":
-     {sessions, max_sessions, rate, queued, throttled, evicted,
+     {sessions, max_sessions, rate, acts, queued, throttled, evicted,
      rejected}, ...}, "pinned_versions": {...}, "cache_hit_rate": ...,
      "gateway/...": ...}
                     (the session gateway's tenant-facing snapshot —
                      surreal_tpu/gateway/, one per metrics row while the
                      gateway is live; rendered by diag's "Gateway"
                      section)
+    {"type": "ops_snapshot", "t": ..., "seq": N, "tiers": T, "dead": D,
+     "breaches": B, "bad_frames": ...}
+                    (one per metrics cadence while the ops plane is
+                     live — a summary POINTER; the full merged snapshot
+                     lives in telemetry/ops_snapshot.json, which
+                     `surreal_tpu top` renders. session/opsplane.py)
+    {"type": "slo_breach", "t": ..., "tenant": "...", "objective": "...",
+     "measured": ..., "target": ..., "budget_used": ..., "exhausted": ...}
+                    (one per breached evaluation window per (tenant,
+                     objective) — counted, never silent.
+                     session/slo.py via the OpsAggregator)
+    {"type": "ops_flightrec", "t": ..., "trigger":
+     "recovery|fault|slo|...", "dir": "...", "snapshots": K, "events": M}
+                    (a flight-recorder dump landed on disk under
+                     telemetry/flightrec/<trigger>/ — the pre-incident
+                     snapshot ring + fault/recovery events, trace-
+                     correlated. session/opsplane.py)
 
 Every event additionally carries ``trace`` (the run-scoped trace id
 SessionHooks mints and spawned components inherit) and ``seq`` (a
@@ -142,6 +159,36 @@ TELEMETRY_DIR = "telemetry"
 EVENTS_FILE = "events.jsonl"
 PROFILES_DIR = "profiles"  # <folder>/telemetry/profiles/<tag>/ captures
 
+# every event ``type`` any module may emit, name -> emitting layer. The
+# GAUGE_REGISTRY discipline (session/costs.py) extended to events: an
+# emit site using a type not documented here fails
+# tests/test_import_hygiene.py's registry lint, so the schema docstring
+# above and diag can never silently drift from what the code writes.
+EVENT_REGISTRY = {
+    "session": "Tracer.__init__ (session/telemetry.py)",
+    "phases": "Tracer.flush_phases (session/telemetry.py)",
+    "span": "Tracer.span(emit=True) side-bands (session/telemetry.py)",
+    "metrics": "Tracer.log_metrics (session/telemetry.py)",
+    "heartbeat": "HeartbeatWriter (session/telemetry.py, own file)",
+    "compile_cache": "SessionHooks compile-cache counters (launch/hooks.py)",
+    "data_plane": "SEED drivers via SessionHooks.data_plane_event",
+    "tune": "autotuner decisions (tune/, launch/ via tune_event)",
+    "recovery": "fault-tolerance layer (session/interrupt.py, "
+                "launch/recovery.py, session/checkpoint.py)",
+    "fault": "chaos firings drained by SessionHooks (utils/faults.py)",
+    "program_cost": "cost/MFU accounting (session/costs.py)",
+    "precision": "active precision policy (launch/hooks.py begin_run)",
+    "hops": "cross-process hop percentiles (launch/seed_trainer.py)",
+    "profile": "on-demand profiler captures (session/profile.py)",
+    "param_fetch": "parameter-service fetches (distributed/param_service.py)",
+    "serving_tier": "inference-fleet snapshot (distributed/fleet.py)",
+    "experience_plane": "sharded experience plane (experience/plane.py)",
+    "gateway": "session gateway tenant snapshot (gateway/server.py)",
+    "ops_snapshot": "ops-plane merged-snapshot pointer (session/opsplane.py)",
+    "slo_breach": "per-tenant SLO window breach (session/slo.py)",
+    "ops_flightrec": "flight-recorder dump record (session/opsplane.py)",
+}
+
 
 def latency_percentiles(samples) -> dict[str, float] | None:
     """{p50, p90, p99, n} of a latency sample window (pure python — used
@@ -168,12 +215,22 @@ class Tracer:
     """
 
     def __init__(self, folder: str | None, enabled: bool = True,
-                 name: str = "train", trace_id: str | None = None):
+                 name: str = "train", trace_id: str | None = None,
+                 max_log_mb: float | None = None):
         self.enabled = bool(enabled) and folder is not None
         self._lock = threading.Lock()
         self._phases: dict[str, list] = {}  # name -> [count, total_s, max_s]
         self._f = None
         self.path = None
+        # size-based rotation (ISSUE 13): a production-length run must not
+        # grow events.jsonl without bound. When the log passes max_log_mb
+        # it rotates to <path>.1 (one generation — the previous .1 is
+        # dropped) and _iter_jsonl/diag read the segments in order.
+        self._max_bytes = (
+            int(float(max_log_mb) * 1e6) if max_log_mb else None
+        )
+        self._bytes = 0
+        self.rotations = 0
         # cross-process trace correlation (ISSUE 6): a run-scoped trace id
         # stamped (with a per-process span-sequence counter) into every
         # event; spawned env workers / the inference server / the param
@@ -192,6 +249,7 @@ class Tracer:
                 os.makedirs(tel_dir, exist_ok=True)
                 self.path = os.path.join(tel_dir, EVENTS_FILE)
                 self._f = open(self.path, "a", buffering=1)  # line-buffered
+                self._bytes = os.path.getsize(self.path)  # resumed session
             except OSError:
                 # telemetry must never kill training (e.g. read-only FS)
                 self.enabled = False
@@ -218,11 +276,23 @@ class Tracer:
             )
             try:
                 self._f.write(line + "\n")
+                self._bytes += len(line) + 1
+                if self._max_bytes and self._bytes > self._max_bytes:
+                    # rotate under the same lock the write holds: close,
+                    # shift to .1 (dropping the previous .1 — two
+                    # generations bound the disk at ~2x max_log_mb),
+                    # reopen fresh
+                    self._f.close()
+                    os.replace(self.path, self.path + ".1")
+                    self._f = open(self.path, "a", buffering=1)
+                    self._bytes = 0
+                    self.rotations += 1
             except OSError:
                 # telemetry must never kill training: a mid-run disk-full/
                 # mount hiccup disables the log instead of propagating
                 try:
-                    self._f.close()
+                    if self._f is not None:
+                        self._f.close()
                 except OSError:
                     pass
                 self._f = None
@@ -355,26 +425,37 @@ class HeartbeatWriter:
 _HEALTH_PREFIXES = ("health/", "loss/", "policy/kl", "episode/return")
 
 
-def _iter_jsonl(path):
+def _iter_jsonl(path, rotated: bool = True):
     """Yield one JSON object per parseable line, tolerating a
     partially-written trailing line. Two torn-tail shapes exist after a
     chaos-harness kill (PR 5) mid-``write``: an incomplete JSON text
     (JSONDecodeError — skipped per line) and a line truncated INSIDE a
     multi-byte UTF-8 sequence, which raises UnicodeDecodeError from the
     file iterator itself unless decoding is lossy — ``errors='replace'``
-    turns it into a replacement char the per-line parse then skips."""
-    try:
-        with open(path, errors="replace") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    yield json.loads(line)
-                except json.JSONDecodeError:
-                    continue  # torn tail line from a live/killed session
-    except OSError:
-        return
+    turns it into a replacement char the per-line parse then skips.
+
+    ``rotated``: the Tracer's size-based rotation (ISSUE 13) shifts a
+    full log to ``<path>.1``; the rotated segment is older, so it is
+    read FIRST and the live file second — one chronological stream. A
+    rotation racing this read at worst repeats or drops lines across
+    the segment boundary; every line still parses (diag's mid-rotation
+    test pins this down)."""
+    paths = [path]
+    if rotated and os.path.exists(path + ".1"):
+        paths.insert(0, path + ".1")
+    for p in paths:
+        try:
+            with open(p, errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line from a live/killed session
+        except OSError:
+            continue
 
 
 def diag_summary(folder: str) -> dict | None:

@@ -74,6 +74,11 @@ Sites and the kinds they honor:
                          that sees the gap first falls back to
                          ``ParameterClient.fetch`` — counted, never
                          silent)
+    ops.push             every ops-plane row push (session/opsplane.py)
+                         (``drop_frame``: swallow the row — the pusher
+                         counts the drop and the aggregator's per-tier
+                         age turns DEAD if the tier stays silent;
+                         ``delay``: sleep ``ms`` first)
     gateway.session      once per gateway serve-loop pass
                          (``drop_frame``: swallow the act reply frame —
                          the client's bounded resend redelivers against
@@ -123,6 +128,7 @@ SITES = frozenset(
         "fleet.replica",
         "param.publish",
         "gateway.session",
+        "ops.push",
     }
 )
 

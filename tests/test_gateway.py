@@ -110,6 +110,47 @@ def test_gateway_attach_act_detach_roundtrip_both_transports():
         fleet.close()
 
 
+def test_gateway_act_frames_stamp_span_and_transit_hops():
+    """GACT frames join the PR-6 hop telemetry (ISSUE 13): a local
+    (shared-clock) client stamps span + t_send on both transports, the
+    server turns them into gateway_transit_ms samples and times every
+    hello into gateway_attach_ms; the span counter is monotonic per
+    session. The codec round-trips the new header fields exactly."""
+    # codec first: span/t_send survive encode->decode bit-exactly
+    obs = np.arange(4, dtype=np.float32)
+    sid = "f" * gw.SID_BYTES
+    kind, obj = gw.decode_payload(
+        gw.encode_act(sid, 9, obs, span=123, t_send=1.5)
+    )
+    assert kind == "act" and obj["session"] == sid
+    assert obj["seq"] == 9 and obj["span"] == 123
+    assert obj["t_send"] == pytest.approx(1.5)
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2, unroll_length=4)
+    server = _gateway(fleet)
+    try:
+        s1 = GatewaySession(server.address, obs_shape=(1, 4))
+        # tcp://127.0.0.1 passes the local-address clock guard: t_send
+        # is real and the span counter advances per act
+        assert s1._stamp_clock is True
+        for _ in range(3):
+            s1.act(np.random.rand(1, 4).astype(np.float32))
+        assert s1._span == 3
+        s2 = GatewaySession(server.address, obs_shape=(1, 4),
+                            transport="pickle")
+        s2.act(np.random.rand(1, 4).astype(np.float32))
+        hops = server.hop_stats()
+        # both transports fed the tenant->gateway transit window, every
+        # hello fed the attach window
+        assert hops["gateway_transit_ms"]["n"] == 4
+        assert hops["gateway_transit_ms"]["p99"] >= 0.0
+        assert hops["gateway_attach_ms"]["n"] == 2
+        s1.close()
+        s2.close()
+    finally:
+        server.close()
+        fleet.close()
+
+
 def test_gateway_reattach_keeps_binding_and_quota():
     """Client churn is not session churn: re-attaching with the granted
     session id AND resume token lands on the SAME record (binding, pin,

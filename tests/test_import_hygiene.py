@@ -363,9 +363,10 @@ def test_no_swallowed_exceptions_in_supervised_code():
 def test_perf_gauges_appear_in_registry():
     """Gauge-registry lint (ISSUE 6 satellite, extended by ISSUE 8 over
     the replay/experience families, ISSUE 10 over the serving-tier
-    fleet/param families, and ISSUE 12 over the gateway family): every
+    fleet/param families, ISSUE 12 over the gateway family, and ISSUE 13
+    over the ops/slo families): every
     ``perf/*``, ``replay/*``, ``experience/*``, ``fleet/*``,
-    ``param/*``, or ``gateway/*`` gauge name emitted
+    ``param/*``, ``gateway/*``, ``ops/*``, or ``slo/*`` gauge name emitted
     anywhere in the package must appear in the documented registry
     (``session/costs.py::GAUGE_REGISTRY``) — an undocumented gauge is
     invisible to diag readers and to the README's knob table. The scan
@@ -377,7 +378,8 @@ def test_perf_gauges_appear_in_registry():
     from surreal_tpu.session.costs import GAUGE_REGISTRY
 
     lit = re.compile(
-        r"[\"']((?:perf|replay|experience|fleet|param|gateway)/[a-z0-9_]+)[\"']"
+        r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo)"
+        r"/[a-z0-9_]+)[\"']"
     )
     bad = []
     for path in sorted(_PKG_ROOT.rglob("*.py")):
@@ -391,15 +393,52 @@ def test_perf_gauges_appear_in_registry():
                     f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
                 )
     assert not bad, (
-        "perf/replay/experience/fleet/param/gateway gauges emitted but not "
-        "documented in session/costs.py::GAUGE_REGISTRY:\n" + "\n".join(bad)
+        "perf/replay/experience/fleet/param/gateway/ops/slo gauges emitted "
+        "but not documented in session/costs.py::GAUGE_REGISTRY:\n"
+        + "\n".join(bad)
     )
     # and the registry names must parse as gauge literals themselves
     for name in GAUGE_REGISTRY:
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
-             "gateway/")
+             "gateway/", "ops/", "slo/")
         ), name
+
+
+def test_telemetry_events_appear_in_registry():
+    """Event-registry lint (ISSUE 13 satellite, the gauge-lint pattern
+    applied to the telemetry spine): every event kind emitted anywhere in
+    the package — ``tracer.event("<kind>", ...)`` and the hook-relayed
+    ``on_event("<kind>", ...)`` spellings — must appear in the documented
+    registry (``session/telemetry.py::EVENT_REGISTRY``). An undocumented
+    event kind is invisible to diag readers and silently skews event-log
+    consumers that filter by kind. Whole-literal calls only, per the
+    repo's metric-name convention."""
+    import re
+
+    from surreal_tpu.session.telemetry import EVENT_REGISTRY
+
+    emit = re.compile(
+        r"(?:\.event|on_event|_on_event|emit_event)\(\s*\n?\s*"
+        r"[\"']([a-z_]+)[\"']"
+    )
+    bad = []
+    for path in sorted(_PKG_ROOT.rglob("*.py")):
+        src = path.read_text()
+        for m in emit.finditer(src):
+            if m.group(1) not in EVENT_REGISTRY:
+                line = src.count("\n", 0, m.start()) + 1
+                bad.append(
+                    f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
+                )
+    assert not bad, (
+        "telemetry event kinds emitted but not documented in "
+        "session/telemetry.py::EVENT_REGISTRY:\n" + "\n".join(bad)
+    )
+    # registry hygiene: lowercase_underscore kinds with descriptions
+    for kind, desc in EVENT_REGISTRY.items():
+        assert re.fullmatch(r"[a-z_]+", kind), kind
+        assert isinstance(desc, str) and desc, kind
 
 
 def test_gateway_reuses_shared_supervision_utilities():

@@ -76,6 +76,57 @@ def test_disabled_tracer_and_unwritable_heartbeat_are_noops(tmp_path):
     hb.beat(1, 2)  # no raise
 
 
+def test_tracer_size_rotation_and_readers_follow_segments(tmp_path):
+    """Size-based event-log rotation (ISSUE 13): a tracer past
+    ``max_log_mb`` shifts the log to ``events.jsonl.1`` and keeps
+    writing; ``_iter_jsonl`` reads rotated-then-live as ONE
+    chronological stream (seq strictly increasing across the boundary)
+    and diag aggregates over both segments."""
+    from surreal_tpu.session.telemetry import _iter_jsonl
+
+    folder = str(tmp_path)
+    # ~500-byte cap: a few metrics rows force multiple rotations
+    tracer = Tracer(folder, name="train", max_log_mb=0.0005)
+    for step in range(40):
+        tracer.log_metrics(step, {"health/grad_norm": float(step)})
+    assert tracer.rotations >= 1
+    tracer.close()
+    path = os.path.join(folder, "telemetry", "events.jsonl")
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    # at most two generations on disk: the rotation drops older segments
+    assert not os.path.exists(path + ".2")
+    events = list(_iter_jsonl(path))
+    assert events, "no events survived rotation"
+    seqs = [e["seq"] for e in events if "seq" in e]
+    assert seqs == sorted(seqs), "segments read out of order"
+    # diag reads THROUGH the rotation: the newest row is the last step
+    s = diag_summary(folder)
+    steps = [e for e in events if e["type"] == "metrics"]
+    assert steps[-1]["step"] == 39
+    assert s["health"]["health/grad_norm"]["last"] == 39.0
+
+
+def test_iter_jsonl_mid_rotation_and_torn_segments(tmp_path):
+    """The hostile shapes a LIVE rotation leaves a concurrent reader:
+    a rotated segment with a torn tail line, a live file still empty —
+    every parseable line still comes out, in segment order, no raise."""
+    from surreal_tpu.session.telemetry import _iter_jsonl
+
+    path = str(tmp_path / "events.jsonl")
+    with open(path + ".1", "w") as f:
+        f.write('{"type": "metrics", "seq": 1}\n')
+        f.write('{"type": "metrics", "seq": 2}\n')
+        f.write('{"type": "metrics", "se')  # torn mid-rotation write
+    with open(path, "w") as f:
+        pass  # the freshly reopened live file: empty is legal
+    assert [e["seq"] for e in _iter_jsonl(path)] == [1, 2]
+    # and the reverse instant: live file has rows, .1 vanished mid-read
+    os.remove(path + ".1")
+    with open(path, "w") as f:
+        f.write('{"type": "metrics", "seq": 3}\n')
+    assert [e["seq"] for e in _iter_jsonl(path)] == [3]
+
+
 def test_diag_cli_missing_folder_returns_2(tmp_path, capsys):
     from surreal_tpu.main.launch import main
 
@@ -257,6 +308,10 @@ def test_perf_gauges_add_no_syncs_beyond_metrics(tmp_path):
         assert m is not None
         assert "perf/mfu" in m and "perf/membw_util" in m, sorted(m)
         assert 0.0 < m["perf/mfu"] < 1.0
+        # the ops-plane snapshot (ISSUE 13) rode the SAME guarded
+        # window: merging tiers, evaluating SLOs and writing the
+        # snapshot file performed zero device->host transfers
+        assert m["ops/snapshots"] >= 1.0
         # and the bare gauge arithmetic is guard-clean in isolation
         with jax.transfer_guard_device_to_host("disallow"):
             g = hooks.costs.gauges(hooks.tracer.last_window)
