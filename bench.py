@@ -301,6 +301,14 @@ def main() -> int:
         from perf_wallclock import ops_plane_main
 
         return ops_plane_main(sys.argv[1:])
+    if "--trace" in sys.argv:
+        # causal tracing + lineage campaign (ISSUE 14): span emit
+        # rate/footprint, exact lineage reduction, modeled per-iteration
+        # overhead fraction — writes BENCH_trace.json (perf_gate's trace
+        # gate consumes it)
+        from perf_wallclock import trace_main
+
+        return trace_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

@@ -446,6 +446,47 @@ def gate_ops(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_trace(art_dir: str, out=sys.stdout) -> int:
+    """The causal-tracing overhead commitment (ISSUE 14), from
+    ``BENCH_trace.json`` (``python bench.py --trace``): every span the
+    head-sampled serving + learner paths emit per iteration (priced at
+    the measured p99 emit cost) PLUS the exact lineage reduction over
+    the full 512x64 version column must cost <= ``overhead_frac_max``
+    (2%) of one steady-state train iteration at the committed headline
+    geometry — tracing the workload must never become the workload.
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_trace.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_trace.json — tracing not measured "
+              "(rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_trace.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    # default mirrors the producer's bound (perf_wallclock.py
+    # TRACE_OVERHEAD_FRAC_MAX) so a field-less artifact can't flip the
+    # verdict
+    frac_max = float(data.get("overhead_frac_max", 0.02))
+    frac = data.get("overhead_frac_of_iter", data.get("value"))
+    iter_ms = data.get("iter_ms")
+    line = (
+        f"perf_gate: trace+lineage {float(frac):.3%} of the iteration"
+        + (f" ({float(iter_ms):.1f} ms)" if iter_ms is not None else "")
+        + f", commitment <= {frac_max:.0%}"
+    )
+    if float(frac) > frac_max:
+        print(line + " — TRACING BECAME THE WORKLOAD", file=out)
+        return 1
+    print(line + " — ok", file=out)
+    return 0
+
+
 def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
     committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
@@ -506,13 +547,13 @@ def gate_tier1(art_dir: str, out=sys.stdout) -> int:
 
 
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
-    # the experience-plane, act-path, gateway, ops-plane, and tier-1
-    # budget gates are independent of the BENCH_r* trail: run them first
-    # and fold their verdicts into every return path
+    # the experience-plane, act-path, gateway, ops-plane, trace, and
+    # tier-1 budget gates are independent of the BENCH_r* trail: run
+    # them first and fold their verdicts into every return path
     xp_rc = max(
         gate_experience(art_dir, out=out), gate_act(art_dir, out=out),
         gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
-        gate_tier1(art_dir, out=out),
+        gate_trace(art_dir, out=out), gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

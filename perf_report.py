@@ -1367,6 +1367,97 @@ def _ops_plane_lines() -> list[str]:
     return lines
 
 
+def _load_trace_bench():
+    """Load the causal-tracing artifact (``BENCH_trace.json``, written by
+    ``bench.py --trace``) if present — same BENCH_host.json discipline:
+    PERF.md regens preserve the measured section without re-running the
+    campaign."""
+    try:
+        with open("BENCH_trace.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or data.get("value") is None:
+        return None  # failed-campaign artifact
+    return data
+
+
+def _trace_lines() -> list[str]:
+    """The 'Causal tracing & lineage' PERF.md section: static mechanism
+    text plus the measured span/lineage cost table from the
+    BENCH_trace.json artifact. One function so ``main()`` and the
+    committed PERF.md cannot drift."""
+    lines = [
+        "",
+        "## Causal tracing & experience lineage",
+        "",
+        "Aggregate gauges say a tier is slow; they cannot say what ONE "
+        "request did. `session/telemetry.py` (ISSUE 14) head-samples "
+        "exemplars (1-in-`telemetry.trace.sample_n` per gateway session "
+        "and per worker stream) and threads a `TraceContext` "
+        "(trace/span/parent ids) through every hop it touches — gateway "
+        "act frame -> fleet replica's coalesced forward -> reply, and "
+        "worker STEP -> inference server -> experience chunk -> the "
+        "learner dispatch that consumed it. Each hop emits a `span` "
+        "event; `surreal_tpu trace <folder>` assembles them into "
+        "per-exemplar span-tree timelines (pure file reading, like "
+        "`top`), with chaos-dropped hops counted in "
+        "`trace/dropped_spans` and rendered as torn, never hidden. "
+        "Independently, every transition is stamped at collection with "
+        "its lineage (worker, episode, step range, acting policy "
+        "version); the learner reduces each batch's version column into "
+        "the EXACT per-update staleness distribution (`lineage/*` "
+        "gauges, pure host numpy over an already-fetched column — zero "
+        "device syncs), which replaces the ops plane's "
+        "published-vs-held staleness approximation in the SLO "
+        "evaluation (`staleness_source: lineage`).",
+    ]
+    tr = _load_trace_bench()
+    if tr:
+        span = tr.get("span_emit_ms") or {}
+        lin = tr.get("lineage_reduce_ms") or {}
+        lines += [
+            "",
+            f"Measured at the headline census ({tr.get('workload', 'benchmark workload')}; "
+            f"`BENCH_trace.json`, platform `{tr.get('platform')}`):",
+            "",
+            "| Cost | p50 ms | p99 ms |",
+            "|---|---|---|",
+        ]
+        for name, row in (
+            ("span emit (JSONL append + exemplar ring)", span),
+            (f"lineage reduce ({tr.get('lineage_rows', '?')} rows)", lin),
+        ):
+            if not row:
+                continue
+            p50, p99 = row.get("p50"), row.get("p99")
+            lines.append(
+                "| {n} | {a} | {b} |".format(
+                    n=name,
+                    a=f"{float(p50):.4f}" if p50 is not None else "n/a",
+                    b=f"{float(p99):.4f}" if p99 is not None else "n/a",
+                )
+            )
+        frac = tr.get("overhead_frac_of_iter")
+        iter_ms = tr.get("iter_ms")
+        lines += [
+            "",
+            f"One span costs {float(tr.get('bytes_per_span', 0)):.0f} B "
+            f"on disk at {float(tr.get('spans_per_s', 0)):,.0f} spans/s"
+            + (
+                f"; the modeled per-iteration census "
+                f"({tr.get('spans_per_iter')} spans priced at p99 + one "
+                f"full lineage reduction) costs {float(frac):.3%} of the "
+                f"{float(iter_ms):.0f} ms steady-state iteration "
+                f"(commitment <= "
+                f"{float(tr.get('overhead_frac_max', 0.02)):.0%})"
+                if frac is not None and iter_ms is not None else ""
+            )
+            + ". Gated by `perf_gate.gate_trace`, folded into `gate()`.",
+        ]
+    return lines
+
+
 def _load_tune_bench():
     """Load the autotuner artifact (``BENCH_tune.json``, written by
     ``surreal_tpu tune ... --out BENCH_tune.json``) if present — like
@@ -2016,6 +2107,7 @@ def main(argv=None) -> None:
     lines += _act_path_lines()
     lines += _gateway_lines()
     lines += _ops_plane_lines()
+    lines += _trace_lines()
     if scaling:
         lines += [
             "",

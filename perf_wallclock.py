@@ -1150,6 +1150,161 @@ def ops_plane_main(argv) -> int:
     return 0
 
 
+# -- causal tracing + lineage (--trace) ---------------------------------------
+
+TRACE_SPANS = 2000       # span-emit microbench sample size
+TRACE_LINEAGE_REPS = 50  # lineage-reduction sample size
+TRACE_SAMPLE_N = 64      # the default head-sampling rate (telemetry.trace.*)
+TRACE_WORKERS = 4        # worker streams at the headline SEED census
+TRACE_HORIZON = 64       # requests per worker stream per iteration
+# the overhead commitment gate_trace enforces: ALL per-iteration tracing
+# work — every head-sampled span the serving + learner paths emit at the
+# default 1-in-64 cadence plus the exact lineage reduction over the full
+# 512x64 version column — costs <= 2% of one steady-state train
+# iteration at the committed headline geometry
+TRACE_OVERHEAD_FRAC_MAX = 0.02
+
+
+def _trace_measure() -> dict:
+    """The tracing/lineage campaign (standalone — no training run):
+    span-emit cost + JSONL footprint from a live Tracer, the exact
+    lineage reduction over one update's version column at the headline
+    geometry (512 envs x 64 horizon), and the modeled per-iteration
+    overhead against the steady-state iteration time.
+
+    The span census is deliberately an UPPER bound: every head-sampled
+    request is charged 2 spans (worker.step + replica.forward) and every
+    sampled chunk 2 more (xplane.relay + learn.dispatch), all priced at
+    the measured p99 emit cost — the real paths emit off the learner
+    thread, so the commitment is conservative, never flattering."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from surreal_tpu.session.telemetry import LineageReducer, Tracer
+
+    def pctl(samples_ms):
+        arr = np.asarray(samples_ms)
+        return {
+            "p50": round(float(np.percentile(arr, 50)), 5),
+            "p99": round(float(np.percentile(arr, 99)), 5),
+        }
+
+    span_ms = []
+    with tempfile.TemporaryDirectory() as folder:
+        tracer = Tracer(folder, enabled=True, name="bench",
+                        trace_sample_n=TRACE_SAMPLE_N)
+        root = tracer.trace_context("bench:warm")
+        tracer.emit_span("bench.span", root, tier="bench", dur_ms=0.1)
+        bytes0 = os.path.getsize(tracer.path)  # line-buffered: current
+        for k in range(TRACE_SPANS):
+            ctx = tracer.trace_context(f"bench:{k}")
+            child = ctx.child(tracer.next_span_id())
+            t0 = time.perf_counter()
+            tracer.emit_span("bench.span", ctx, tier="bench",
+                             dur_ms=0.1, version=k)
+            tracer.emit_span("bench.child", child, tier="bench",
+                             dur_ms=0.1)
+            span_ms.append((time.perf_counter() - t0) * 1e3 / 2.0)
+        bytes_per_span = (os.path.getsize(tracer.path) - bytes0) / (
+            2.0 * TRACE_SPANS
+        )
+        tracer.close()
+    # one update's acting-version column at the headline geometry:
+    # 512 x 64 transitions spread over 4 distinct policy versions
+    # (a mid-run fanout publish mixing generations)
+    n_rows = 512 * 64
+    versions = np.repeat(
+        np.asarray([37, 38, 39, 40], dtype=np.int32), n_rows // 4
+    )
+    reducer = LineageReducer()
+    reducer.reduce(41, versions)  # warm (numpy dispatch outside timing)
+    lineage_ms = []
+    for _ in range(TRACE_LINEAGE_REPS):
+        t0 = time.perf_counter()
+        reducer.reduce(41, versions)
+        lineage_ms.append((time.perf_counter() - t0) * 1e3)
+    iter_ms = _ops_iter_ms()
+    span = pctl(span_ms)
+    lineage = pctl(lineage_ms)
+    # the modeled per-iteration span census (upper bound, see docstring)
+    sampled = max(1, TRACE_WORKERS * TRACE_HORIZON // TRACE_SAMPLE_N)
+    spans_per_iter = 2 * sampled + 2
+    trace_ms_per_iter = spans_per_iter * span["p99"] + lineage["p99"]
+    return {
+        "span_emit_ms": span,
+        "spans_per_s": round(1000.0 / max(span["p50"], 1e-6), 1),
+        "bytes_per_span": round(bytes_per_span, 1),
+        "lineage_reduce_ms": lineage,
+        "lineage_rows": n_rows,
+        "iter_ms": round(iter_ms, 3),
+        "spans_per_iter": spans_per_iter,
+        "trace_ms_per_iter": round(trace_ms_per_iter, 4),
+        "overhead_frac_of_iter": round(trace_ms_per_iter / iter_ms, 5),
+        "sample_n": TRACE_SAMPLE_N,
+        "workload": (
+            f"{TRACE_WORKERS} worker streams x {TRACE_HORIZON} requests, "
+            f"1-in-{TRACE_SAMPLE_N} head-sampled, 2 spans/request + "
+            f"2 learner spans; lineage over {n_rows} rows / 4 versions; "
+            "iter: PPO jax:cartpole 512x64 (1 epoch)"
+        ),
+    }
+
+
+def trace_main(argv) -> int:
+    """--trace driver (ISSUE 14): per-iteration cost of causal span
+    exemplars + exact experience lineage — span emit rate/footprint,
+    lineage reduction over the headline version column, modeled overhead
+    fraction against the steady-state iteration. Writes
+    ``BENCH_trace.json`` (perf_gate.gate_trace and PERF.md's generated
+    section consume it), with bench.py's bounded retry/backoff and
+    structured failed-round artifact."""
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_trace.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            row = _trace_measure()
+            result = {
+                "metric": "trace_overhead_frac_of_iter",
+                "value": row["overhead_frac_of_iter"],
+                "unit": "frac",
+                "geometry": row["workload"],
+                "overhead_frac_max": TRACE_OVERHEAD_FRAC_MAX,
+                **row,
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"trace attempt {attempt + 1}/{RETRY_ATTEMPTS} "
+                    f"failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -1165,6 +1320,8 @@ def main(argv=None) -> None:
         sys.exit(gateway_main(argv))
     if "--ops-plane" in argv:
         sys.exit(ops_plane_main(argv))
+    if "--trace" in argv:
+        sys.exit(trace_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

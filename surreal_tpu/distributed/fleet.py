@@ -99,6 +99,9 @@ class InferenceFleet:
         act_history: int = 8,
         ops_address: str | None = None,
         ops_interval_s: float = 1.0,
+        span_sink=None,
+        trace_sample_n: int = 0,
+        lineage: bool = True,
     ):
         if replicas < 1:
             raise ValueError(f"inference_fleet.replicas must be >= 1, got {replicas}")
@@ -132,7 +135,11 @@ class InferenceFleet:
             chunks=self.chunks,
             ops_address=ops_address,
             ops_interval_s=ops_interval_s,
+            span_sink=span_sink,
+            trace_sample_n=trace_sample_n,
+            lineage=lineage,
         )
+        self._span_sink = span_sink
         self.min_replicas = max(1, int(min_replicas))
         self.max_replicas = max(self.min_replicas, int(max_replicas))
         self.autoscale = bool(autoscale)
@@ -347,7 +354,7 @@ class InferenceFleet:
         return list(self._act_history)
 
     def serve_act(self, obs, *, replica: int | None = None,
-                  version: int | None = None):
+                  version: int | None = None, span_ctx=None):
         """Gateway ingress: one synchronous forward in the CALLER's
         thread — the session tier's act path, separate from the workers'
         coalesced serve loop. Returns ``(actions, served_version)``.
@@ -357,7 +364,13 @@ class InferenceFleet:
         its table instead of silently serving elsewhere. ``version``
         pins the forward to a held closure from the act-fn history;
         an evicted version raises ``KeyError`` — the gateway's counted
-        catch_up path, never a silent jump."""
+        catch_up path, never a silent jump.
+
+        ``span_ctx`` (a child :class:`TraceContext` from a head-sampled
+        gateway act) emits a ``replica.forward`` span under it and asks
+        the replica to ADOPT the exemplar — its next completed worker
+        chunk carries the id to the learner, closing the gateway →
+        replica → learner tree."""
         import numpy as np
 
         slot = self.replica_of(0) if replica is None else int(replica)
@@ -367,6 +380,7 @@ class InferenceFleet:
         )
         if srv is None or not srv.alive:
             raise LookupError(f"replica {slot} is not alive")
+        t0 = time.monotonic() if span_ctx is not None else 0.0
         if version is None or version == self._version:
             # current policy: serialize against set_act_fn's swap (the
             # replica's own serve discipline)
@@ -383,6 +397,15 @@ class InferenceFleet:
             # a held closure is immutable — no lock needed
             actions, _ = fn(obs)
             served = int(version)
+        if span_ctx is not None and self._span_sink is not None:
+            self._span_sink.emit_span(
+                "replica.forward",
+                span_ctx,
+                tier=f"fleet.replica{slot}",
+                dur_ms=(time.monotonic() - t0) * 1e3,
+                version=int(served),
+            )
+            srv.note_exemplar(span_ctx.exemplar, span_ctx.span_id)
         return np.asarray(actions), served
 
     def episode_stats(self) -> dict[str, float] | None:

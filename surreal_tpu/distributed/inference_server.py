@@ -29,6 +29,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Callable
 
@@ -42,11 +43,16 @@ from surreal_tpu.utils import faults
 class _WorkerTrack:
     """Per-(worker, slot) trajectory assembly state."""
 
-    __slots__ = ("pending", "steps")
+    __slots__ = ("pending", "steps", "ep", "step_idx")
 
     def __init__(self):
         self.pending: dict | None = None  # {obs, action, info} awaiting outcome
         self.steps: list[dict] = []
+        # experience lineage (ISSUE 14): per-env episode / in-episode step
+        # counters, stamped onto every transition at collection and
+        # advanced on done boundaries — lazily sized to the slice width
+        self.ep: np.ndarray | None = None
+        self.step_idx: np.ndarray | None = None
 
 
 class _WorkerState:
@@ -107,6 +113,9 @@ class InferenceServer:
         ops_address: str | None = None,
         ops_tier: str = "fleet.replica0",
         ops_interval_s: float = 1.0,
+        span_sink=None,
+        trace_sample_n: int = 0,
+        lineage: bool = True,
     ):
         # version: starting params version. The fleet supervisor
         # (distributed/fleet.py) respawns a crashed replica with the
@@ -173,6 +182,18 @@ class InferenceServer:
         self._ops_address = ops_address
         self._ops_tier = str(ops_tier)
         self._ops_interval_s = float(ops_interval_s)
+        # causal trace exemplars (ISSUE 14): span_sink is the session's
+        # shared Tracer (every replica is a thread of the session
+        # process); trace_sample_n head-samples 1-in-N worker STEP spans
+        # (0 = off). lineage gates the per-transition provenance stamp.
+        # _pending_exemplar: the exemplar the NEXT completed chunk adopts
+        # — set by a sampled worker step or by the gateway act path
+        # (fleet.serve_act note_exemplar), popped when a chunk ships, so
+        # the learner's dispatch span joins the same tree.
+        self._span_sink = span_sink
+        self.trace_sample_n = int(trace_sample_n)
+        self.lineage = bool(lineage)
+        self._pending_exemplar: dict | None = None
 
         # rolling completed-episode stats shipped by workers (SURVEY.md
         # §5.5); read via episode_stats(). Window matches the host
@@ -216,6 +237,16 @@ class InferenceServer:
         """Current params version (== number of set_act_fn calls)."""
         with self._act_lock:
             return self._version
+
+    def note_exemplar(self, exemplar: str, parent_span: int) -> None:
+        """Adopt a foreign trace exemplar (the gateway act path,
+        fleet.serve_act): this replica's NEXT completed chunk carries it,
+        so the learner's dispatch span lands in the same tree — the
+        gateway -> replica -> learner-side correlation. GIL-atomic dict
+        assignment; newest exemplar wins."""
+        self._pending_exemplar = {
+            "exemplar": str(exemplar), "parent": int(parent_span)
+        }
 
     def address_for(self, worker_id: int) -> str:
         """Uniform routing surface with :class:`~surreal_tpu.distributed.
@@ -491,6 +522,7 @@ class InferenceServer:
             info = dict(info, param_version=np.full(len(obs), self._version, np.int32))
         actions = np.asarray(actions)
         info = {k: np.asarray(v) for k, v in info.items()}
+        self._emit_step_spans(requests, (time.monotonic() - t0) * 1e3)
         if len(requests) == 1:
             ident, msg = requests[0]
             self._record(ident, msg, actions, info)
@@ -515,6 +547,43 @@ class InferenceServer:
             b if self._serve_batch_ewma is None
             else 0.1 * b + 0.9 * self._serve_batch_ewma
         )
+
+    def _emit_step_spans(self, requests, forward_ms: float) -> None:
+        """Head-sampled worker-path causal spans (ISSUE 14): 1-in-N STEP
+        frames (by the worker's own span seq — the FIRST step of every
+        stream is always an exemplar) get a worker-tier root span (wire
+        transit, same-host clocks) and a replica forward child; the
+        exemplar is parked for the next completed chunk so the learner's
+        dispatch span completes the tree. Runs BEFORE _record so a chunk
+        finished by this very serve can already adopt it."""
+        sink = self._span_sink
+        if sink is None or self.trace_sample_n <= 0:
+            return
+        from surreal_tpu.session.telemetry import head_sampled
+
+        for ident, msg in requests:
+            span_seq = int(msg.get("span") or 0)
+            if not head_sampled(span_seq, self.trace_sample_n):
+                continue
+            wid = ident.decode(errors="replace")[-8:]
+            root = sink.trace_context(f"{self._ops_tier}:{wid}:s{span_seq}")
+            t_send = msg.get("t_send")
+            transit = (
+                max(0.0, (time.time() - float(t_send)) * 1e3)
+                if isinstance(t_send, (int, float)) and t_send > 0 else None
+            )
+            sink.emit_span(
+                "worker.step", root, tier="worker", dur_ms=transit,
+                worker=wid, step_span=span_seq,
+            )
+            child = root.child(sink.next_span_id())
+            sink.emit_span(
+                "replica.forward", child, tier=self._ops_tier,
+                dur_ms=forward_ms, version=self._version,
+            )
+            self._pending_exemplar = {
+                "exemplar": root.exemplar, "parent": child.span_id
+            }
 
     def episode_stats(self) -> dict[str, float] | None:
         """Rolling mean return/length over the last completed episodes
@@ -553,26 +622,31 @@ class InferenceServer:
             terminal_obs = np.asarray(msg.get("terminal_obs", obs2))
             done_b = done.reshape(done.shape + (1,) * (obs2.ndim - 1))
             truncated = np.asarray(msg.get("truncated", np.zeros_like(done)))
-            track.steps.append(
-                {
-                    "obs": prev["obs"],
-                    "next_obs": np.where(done_b, terminal_obs, obs2),
-                    "action": prev["action"],
-                    "reward": np.asarray(msg["reward"]),
-                    "done": done,
-                    "terminated": done & ~truncated,
-                    "behavior_logp": prev["info"]["logp"],
-                    "behavior": {
-                        k: v
-                        for k, v in prev["info"].items()
-                        if k in ("mean", "log_std", "logits")
-                    },
-                    # version of the params that CHOSE this action — the
-                    # staleness bookkeeping PPO-over-SEED needs to drop or
-                    # correct windows acted by long-dead policies
-                    "param_version": prev["info"]["param_version"],
-                }
-            )
+            step = {
+                "obs": prev["obs"],
+                "next_obs": np.where(done_b, terminal_obs, obs2),
+                "action": prev["action"],
+                "reward": np.asarray(msg["reward"]),
+                "done": done,
+                "terminated": done & ~truncated,
+                "behavior_logp": prev["info"]["logp"],
+                "behavior": {
+                    k: v
+                    for k, v in prev["info"].items()
+                    if k in ("mean", "log_std", "logits")
+                },
+                # version of the params that CHOSE this action — the
+                # staleness bookkeeping PPO-over-SEED needs to drop or
+                # correct windows acted by long-dead policies
+                "param_version": prev["info"]["param_version"],
+            }
+            if self.lineage:
+                # experience lineage (ISSUE 14): (worker, episode, step)
+                # provenance stamped AT COLLECTION — nested dict, so the
+                # chunk stacker below and the wire's '/'-flattening carry
+                # it as lineage/* columns with no special casing
+                step["lineage"] = self._lineage_stamp(ident, track, done)
+            track.steps.append(step)
         if final:
             track.pending = None  # worker is exiting; nothing more will come
         else:
@@ -592,6 +666,13 @@ class InferenceServer:
                 )
                 for k in track.steps[0]
             }
+            ex = self._pending_exemplar
+            if ex is not None:
+                # trace-exemplar handoff: chunk METADATA (like _t_ready),
+                # popped host-side by the trainer before device_put / the
+                # relay before the wire — never a data column
+                chunk["_exemplar"] = dict(ex)
+                self._pending_exemplar = None
             # birth stamp for the queue-latency gauge; consumers pop it
             # (seed_trainer's _DataPlane.next_chunk) before training
             chunk["_t_ready"] = time.monotonic()
@@ -613,6 +694,28 @@ class InferenceServer:
                         )
                     except queue.Empty:
                         pass
+
+    def _lineage_stamp(self, ident: bytes, track: _WorkerTrack,
+                       done: np.ndarray) -> dict[str, np.ndarray]:
+        """One transition's lineage columns for a slice of width B:
+        worker uid (crc32 of the zmq identity — stable across respawns
+        under ROUTER_HANDOVER), per-env episode number, per-env
+        in-episode step. Counters advance AFTER stamping and reset on
+        done boundaries (the stamp describes the step that was acted,
+        not the one coming)."""
+        d = np.asarray(done, bool).reshape(-1)
+        b = d.shape[0]
+        if track.ep is None or track.ep.shape[0] != b:
+            track.ep = np.zeros(b, np.int32)
+            track.step_idx = np.zeros(b, np.int32)
+        stamp = {
+            "worker": np.full(b, zlib.crc32(ident) & 0x7FFFFFFF, np.int32),
+            "episode": track.ep.copy(),
+            "step": track.step_idx.copy(),
+        }
+        track.step_idx = np.where(d, 0, track.step_idx + 1).astype(np.int32)
+        track.ep = np.where(d, track.ep + 1, track.ep).astype(np.int32)
+        return stamp
 
     def hop_stats(self) -> dict[str, dict]:
         """Per-hop latency percentiles for the cross-process timeline

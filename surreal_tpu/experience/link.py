@@ -99,6 +99,7 @@ def negotiate_link(
     stop_event=None,
     seq_base: int | None = None,
     force_tcp: bool = False,
+    caps: tuple[str, ...] = (),
 ) -> dict | None:
     """Run the hello handshake on one link.
 
@@ -124,6 +125,7 @@ def negotiate_link(
             "spec": spec.to_json() if spec else None,
             "slot_rows": int(slot_rows), "slots": int(slots),
             "transport": "pickle", "trace": trace, "token": token,
+            "caps": sorted(caps),
         }
         if seq_base is not None:
             msg["seq_base"] = int(seq_base)
@@ -132,6 +134,7 @@ def negotiate_link(
         payload = wire.encode_hello(
             role, spec, slot_rows, slots, want,
             trace=trace, token=token, seq_base=seq_base or 0,
+            caps=caps,
         )
     try:
         send(payload)

@@ -129,6 +129,9 @@ class ExperienceSender:
             role="sender", spec=self.spec, slot_rows=self.slot_rows,
             slots=self.insert_slots, mode=self.mode, timeout_s=timeout_s,
             trace=self.trace, stop_event=self._stop, seq_base=link.seq,
+            # declared, not required: lineage columns are just more spec
+            # fields to a shard that ignores the cap (wire-compat seam)
+            caps=("lineage",),
         )
         if obj is None:
             return self._mark_dead(link)

@@ -235,7 +235,7 @@ class HostFifo:
 
 class _Peer:
     __slots__ = ("role", "transport", "spec", "slab", "views", "floor",
-                 "applied", "trace", "slot_rows", "slots")
+                 "applied", "trace", "slot_rows", "slots", "caps")
 
     def __init__(self):
         self.role = "sender"
@@ -254,6 +254,10 @@ class _Peer:
         self.trace = None
         self.slot_rows = 0
         self.slots = 0
+        # negotiated capability set from the hello (ISSUE 14): additive
+        # and advisory — a pre-caps hello leaves it empty and everything
+        # still works (lineage columns are ordinary spec fields)
+        self.caps: set[str] = set()
 
     def seen(self, seq: int) -> bool:
         return seq <= self.floor or seq in self.applied
@@ -358,6 +362,7 @@ def run_shard_server(
             peer.floor = base
         peer.role = info.get("role", "sender")
         peer.trace = info.get("trace")
+        peer.caps = set(info.get("caps") or ())
         peer.slot_rows = int(info.get("slot_rows", 0))
         peer.slots = int(info.get("slots", 0))
         token = info.get("token")

@@ -238,7 +238,8 @@ SAMPLE_EXTRAS = (("_idx", (), np.uint32), ("_is_weights", (), np.float32))
 
 def encode_hello(role: str, spec: PlaneSpec | None, slot_rows: int,
                  slots: int, transport: str, trace: str | None = None,
-                 token: str | None = None, seq_base: int = 0) -> bytes:
+                 token: str | None = None, seq_base: int = 0,
+                 caps: Sequence[str] = ()) -> bytes:
     # token: per-attempt correlation nonce the reply must echo — a client
     # that retried its hello must not pair with the STALE attempt's grant
     # (the superseded slab would leak and, worse, the two sides would
@@ -246,6 +247,11 @@ def encode_hello(role: str, spec: PlaneSpec | None, slot_rows: int,
     # seq_base: the sender's current seq at hello time — the shard
     # re-bases its exactly-once dedup floor on it (everything at or below
     # is settled or permanently dropped on the sender side)
+    # caps: additive capability list (ISSUE 14): "lineage" declares the
+    # sender's spec carries the lineage/* provenance columns. Peers read
+    # it with .get — a pre-caps hello negotiates nothing extra and the
+    # spec seam already makes unknown columns just more fields (never a
+    # struct.error on mixed versions)
     return MAGIC + bytes([XHELLO]) + json.dumps(
         {
             "role": role,
@@ -257,6 +263,7 @@ def encode_hello(role: str, spec: PlaneSpec | None, slot_rows: int,
             "token": token,
             "seq_base": int(seq_base),
             "pid": os.getpid(),
+            "caps": sorted(caps),
         }
     ).encode()
 

@@ -106,7 +106,12 @@ def encode_hello(tenant: str, *, session: str | None = None,
                  token: str | None = None,
                  obs_shape=(), obs_dtype: str = "<f4",
                  transport: str = "tcp", pin_version: int | None = None,
-                 trace: str | None = None) -> bytes:
+                 trace: str | None = None,
+                 caps: tuple[str, ...] = ()) -> bytes:
+    # ``caps`` is the negotiated-capability seam (ISSUE 14 satellite):
+    # optional features ("trace" span exemplars) ride the JSON hello as
+    # an additive list the server reads with ``.get`` — a pre-caps peer
+    # simply negotiates nothing extra, never a decode error
     return MAGIC + bytes([GHELLO]) + json.dumps(
         {
             "tenant": str(tenant),
@@ -117,6 +122,7 @@ def encode_hello(tenant: str, *, session: str | None = None,
             "transport": transport,
             "pin_version": pin_version,
             "trace": trace,
+            "caps": sorted(caps),
         }
     ).encode()
 
@@ -297,7 +303,7 @@ class GatewaySession:
                  obs_shape=(), obs_dtype: str = "<f4",
                  transport: str = "tcp", pin_version: int | None = None,
                  trace: str | None = None, timeout_s: float = 5.0,
-                 retries: int = 3):
+                 retries: int = 3, caps: tuple[str, ...] = ("trace",)):
         if transport not in ("tcp", "pickle"):
             raise ValueError(f"transport {transport!r} not in tcp|pickle")
         self.tenant = str(tenant)
@@ -329,6 +335,7 @@ class GatewaySession:
         self.lease_s: float | None = None
         self.replica: int | None = None
         self.pinned_version: int | None = None
+        self.caps = tuple(caps)
         self._attach(session, token, pin_version, trace)
 
     def _recv(self, timeout_s: float) -> tuple[str, Any] | None:
@@ -342,6 +349,7 @@ class GatewaySession:
             self.tenant, session=session, token=token,
             obs_shape=self.obs_shape, obs_dtype=self.obs_dtype.str,
             transport=self.transport, pin_version=pin_version, trace=trace,
+            caps=self.caps,
         )
         for _ in range(self.retries):
             self._sock.send(hello)

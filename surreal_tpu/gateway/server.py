@@ -93,6 +93,8 @@ class GatewayServer:
         respawn_backoff_cap_s: float = 30.0,
         ops_address: str | None = None,
         ops_interval_s: float = 1.0,
+        span_sink=None,
+        trace_sample_n: int = 0,
     ):
         self.fleet = fleet
         self.address = bind or alloc_address()
@@ -112,6 +114,16 @@ class GatewayServer:
         # session id alone routes but does not authenticate). Not
         # journaled — a credential never crosses the checkpoint wire.
         self._resume_tokens: dict[str, str] = {}
+        # negotiated per-session capability sets from the hello's "caps"
+        # list (ISSUE 14): "trace" opts the session's acts into the
+        # head-sampled causal span exemplars. A pre-caps client simply
+        # negotiates none — absence is a degrade, never a decode error.
+        self._session_caps: dict[str, set] = {}
+        # causal trace exemplars: the Tracer this gateway emits
+        # `gateway.act` root spans to, 1-in-trace_sample_n per session
+        # stream (0 = off)
+        self._span_sink = span_sink
+        self.trace_sample_n = int(trace_sample_n)
         self._cache_cap = int(act_cache)
         self._cache: "OrderedDict[tuple, tuple[np.ndarray, int]]" = (
             OrderedDict()
@@ -228,6 +240,7 @@ class GatewayServer:
                     self._release_pin(rec)
                     self._obs_specs.pop(rec.session, None)
                     self._resume_tokens.pop(rec.session, None)
+                    self._session_caps.pop(rec.session, None)
             for tenant in list(self.admission.tenants()):
                 for req in self.admission.drain(tenant):
                     self._serve_one(sock, req)
@@ -291,6 +304,7 @@ class GatewayServer:
                 self._release_pin(rec)
                 self._obs_specs.pop(rec.session, None)
                 self._resume_tokens.pop(rec.session, None)
+                self._session_caps.pop(rec.session, None)
             self._reply(sock, ident, gw.encode_detach_ok(
                 obj["session"], rec.acts if rec else 0
             ))
@@ -422,6 +436,7 @@ class GatewayServer:
                 self.table.touch(rec.session)
                 self.reattaches += 1
                 self._obs_specs[rec.session] = spec
+                self._session_caps[rec.session] = set(obj.get("caps") or ())
                 self._reply(sock, ident, gw.encode_hello_ok(
                     rec.session, self.lease_s, rec.transport,
                     rec.replica, rec.pinned_version,
@@ -463,6 +478,7 @@ class GatewayServer:
         self.attaches += 1
         self._obs_specs[sid] = spec
         self._resume_tokens[sid] = token
+        self._session_caps[sid] = set(obj.get("caps") or ())
         self._reply(sock, ident, gw.encode_hello_ok(
             sid, self.lease_s, transport, replica, pin, token=token
         ))
@@ -520,6 +536,15 @@ class GatewayServer:
             return
         t0 = time.monotonic()
         flags = 0
+        # head-sampled causal exemplar (ISSUE 14): the root span of a
+        # gateway → replica → learner tree. The child ctx rides into
+        # serve_act, which emits replica.forward under it and asks the
+        # replica to adopt the exemplar onto its next learner chunk.
+        span_root = self._trace_root(rec, seq)
+        span_child = (
+            span_root.child(self._span_sink.next_span_id())
+            if span_root is not None else None
+        )
         if (
             rec.pinned_version is not None
             and rec.pinned_version not in self.fleet.held_versions()
@@ -547,11 +572,20 @@ class GatewayServer:
                 actions, served = hit
                 self._finish_act(sock, ident, rec, seq, actions, served,
                                  flags | gw.F_CACHED, t0)
+                if span_root is not None:
+                    # cache hits never reach a replica: the root is the
+                    # whole tree (and says so)
+                    self._span_sink.emit_span(
+                        "gateway.act", span_root, tier="gateway",
+                        dur_ms=(time.monotonic() - t0) * 1e3,
+                        tenant=rec.tenant, seq=int(seq), cached=True,
+                    )
                 return
             self.cache_misses += 1
         try:
             actions, served = self.fleet.serve_act(
-                obs, replica=rec.replica, version=rec.pinned_version
+                obs, replica=rec.replica, version=rec.pinned_version,
+                span_ctx=span_child,
             )
         except KeyError:
             # (before LookupError: KeyError IS a LookupError.) the
@@ -567,7 +601,7 @@ class GatewayServer:
             flags |= gw.F_UNPINNED
             try:
                 actions, served = self.fleet.serve_act(
-                    obs, replica=rec.replica
+                    obs, replica=rec.replica, span_ctx=span_child
                 )
             except LookupError:
                 self._reply(sock, ident, gw.encode_act_err(
@@ -583,7 +617,8 @@ class GatewayServer:
                 return
             try:
                 actions, served = self.fleet.serve_act(
-                    obs, replica=rec.replica, version=rec.pinned_version
+                    obs, replica=rec.replica, version=rec.pinned_version,
+                    span_ctx=span_child,
                 )
             except KeyError:
                 self.catch_ups += 1
@@ -593,7 +628,7 @@ class GatewayServer:
                 flags |= gw.F_UNPINNED
                 try:
                     actions, served = self.fleet.serve_act(
-                        obs, replica=rec.replica
+                        obs, replica=rec.replica, span_ctx=span_child
                     )
                 except LookupError:
                     self._reply(sock, ident, gw.encode_act_err(
@@ -611,6 +646,27 @@ class GatewayServer:
             while len(self._cache) > self._cache_cap:
                 self._cache.popitem(last=False)
         self._finish_act(sock, ident, rec, seq, actions, served, flags, t0)
+        if span_root is not None:
+            self._span_sink.emit_span(
+                "gateway.act", span_root, tier="gateway",
+                dur_ms=(time.monotonic() - t0) * 1e3,
+                tenant=rec.tenant, seq=int(seq), version=int(served),
+            )
+
+    def _trace_root(self, rec: SessionRecord, seq: int):
+        """Root :class:`TraceContext` for this act, or None: requires a
+        span sink, sampling on, the session's negotiated "trace" cap,
+        and the 1-in-N head sample over the session's seq stream."""
+        sink = self._span_sink
+        if sink is None or self.trace_sample_n <= 0:
+            return None
+        if "trace" not in self._session_caps.get(rec.session, ()):
+            return None
+        from surreal_tpu.session.telemetry import head_sampled
+
+        if not head_sampled(seq, self.trace_sample_n):
+            return None
+        return sink.trace_context(f"gw:{rec.session[:6]}:a{int(seq)}")
 
     def _finish_act(self, sock, ident, rec, seq, actions, served, flags,
                     t0) -> None:

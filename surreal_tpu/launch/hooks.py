@@ -93,6 +93,22 @@ class SessionHooks:
         # checkpoint) below. `.get` keeps configs saved before the knob
         # existed loadable.
         tel = cfg.get("telemetry", None)
+        # causal tracing + lineage knobs (ISSUE 14): telemetry.trace.*
+        # sets the exemplar head-sampling rate (1-in-N per stream; 0
+        # disables span emission) and how many recent exemplars ride a
+        # flight-recorder dump; telemetry.lineage toggles the
+        # per-transition provenance stamps (on by default — the exact
+        # staleness distribution depends on them)
+        trace_cfg = tel.get("trace", None) if tel is not None else None
+        self.trace_sample_n = int(
+            trace_cfg.get("sample_n", 64) if trace_cfg is not None else 64
+        )
+        trace_keep = int(
+            trace_cfg.get("keep", 8) if trace_cfg is not None else 8
+        )
+        self.lineage_enabled = bool(
+            tel.get("lineage", True) if tel is not None else True
+        )
         self.tracer = Tracer(
             cfg.folder,
             enabled=bool(tel.enabled) if tel is not None else True,
@@ -100,6 +116,8 @@ class SessionHooks:
             # size-based JSONL rotation (ISSUE 13 satellite): a week-long
             # run must not grow events.jsonl without bound
             max_log_mb=tel.get("max_log_mb", None) if tel is not None else None,
+            trace_sample_n=self.trace_sample_n,
+            trace_keep=trace_keep,
         )
         # cross-process trace correlation: the run-scoped trace id every
         # telemetry event carries; spawned env workers / the inference
@@ -156,6 +174,10 @@ class SessionHooks:
             cfg=cfg.get("ops", None), slo_cfg=cfg.get("slo", None),
             on_event=self.tracer.event,
         )
+        # the last-K causal exemplar span trees ride every flightrec
+        # dump (ISSUE 14): a post-mortem sees individual request paths
+        # from the minutes before the incident, not just gauges
+        self.ops.flightrec.exemplar_source = self.tracer.recent_exemplar_spans
         self._interrupt_logged = False
         # optional step-aligned auxiliary state (the off-policy trainer
         # sets this to snapshot its replay buffer when

@@ -688,6 +688,10 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                 with tracer.span("chunk-wait"):
                     chunk = plane.next_chunk()
                 versions = chunk.pop("param_version")
+                # lineage stamps / exemplar metadata are host-side only
+                # (ISSUE 14) — they must not enter the collective batch
+                chunk.pop("lineage", None)
+                chunk.pop("_exemplar", None)
                 staleness = server.version - int(versions.min())
                 gbatch = local_batch_to_global(self.mesh, chunk, batch_dim=1)
                 key, lkey, hk_key = jax.random.split(key, 3)

@@ -282,6 +282,13 @@ class SEEDTrainer:
         # data plane spawns; every wire tier (fleet replicas, experience
         # shards, gateway) inherits the aggregator address the same way
         self._ops_address: str | None = None
+        # causal tracing + lineage (ISSUE 14): run() points the span sink
+        # at the hooks tracer and reads the telemetry.trace.* knobs; the
+        # defaults keep embedders (the multi-host subclass sets only
+        # _trace_id) span-free but lineage-stamped
+        self._span_sink = None
+        self._trace_sample_n = 0
+        self._lineage = True
         n_envs = int(config.env_config.num_envs)
         # pipelined sub-slices halve the per-chunk batch width, so the
         # learn program compiles once per width: keep widths uniform (even
@@ -420,6 +427,10 @@ class SEEDTrainer:
             sanitize_obs=bool(topo.get("sanitize_obs", True)),
             # ops plane: replicas push their own rows to the aggregator
             ops_address=self._ops_address,
+            # causal trace exemplars + per-transition lineage stamps
+            span_sink=self._span_sink,
+            trace_sample_n=self._trace_sample_n,
+            lineage=self._lineage,
         )
         # serving tier (ISSUE 10, distributed/fleet.py): >1 replica (or
         # autoscale on) runs the replicated fleet with session-affinity
@@ -542,6 +553,12 @@ class SEEDTrainer:
             # workers inherit the run-scoped trace id via spawn kwargs
             self._trace_id = hooks.trace_id
             self._ops_address = hooks.ops.address
+            # causal tracing + lineage (ISSUE 14): the hooks tracer is
+            # the one span sink for every tier in this process, and the
+            # telemetry.trace.* knobs set the head-sampling rate
+            self._span_sink = hooks.tracer
+            self._trace_sample_n = hooks.trace_sample_n
+            self._lineage = hooks.lineage_enabled
             # the FIRST chunk waits out the policy's XLA compiles plus a
             # full unroll of round trips (can be minutes on a tunneled
             # TPU); workers keep their own 120s liveness budget per step,
@@ -600,6 +617,10 @@ class SEEDTrainer:
                         gw_cfg.get("respawn_backoff_cap_s", 30.0)
                     ),
                     ops_address=hooks.ops.address,
+                    # head-sampled gateway.act root spans for sessions
+                    # that negotiated the "trace" cap
+                    span_sink=self._span_sink,
+                    trace_sample_n=self._trace_sample_n,
                 )
                 self._gateway = gateway  # exposed for tests
                 hooks.log.info("session gateway live at %s", gateway.address)
@@ -628,6 +649,25 @@ class SEEDTrainer:
                             continue
                         chunk = dict(chunk)
                         chunk.pop("_t_ready", None)
+                        # chunk METADATA (not a wire column): an adopted
+                        # exemplar ends its tree at the relay hop here —
+                        # the lineage COLUMNS still cross the wire as
+                        # ordinary spec fields
+                        ex = chunk.pop("_exemplar", None)
+                        if ex is not None and self._span_sink is not None:
+                            from surreal_tpu.session.telemetry import (
+                                TraceContext,
+                            )
+
+                            self._span_sink.emit_span(
+                                "xplane.relay",
+                                TraceContext(
+                                    ex["exemplar"],
+                                    self._span_sink.next_span_id(),
+                                    ex["parent"],
+                                ),
+                                tier="experience",
+                            )
                         try:
                             xplane.sender.send_chunk(chunk)
                         except Exception as e:
@@ -684,6 +724,12 @@ class SEEDTrainer:
                     else plane.next_chunk()
                 )
                 versions = chunk.pop("param_version")
+                # lineage stamps and the adopted exemplar stay HOST-side
+                # (the staleness/provenance decisions need them before
+                # any device work; the transfer-guard proves the lineage
+                # reduction adds no device->host syncs)
+                lineage = chunk.pop("lineage", None)
+                exemplar = chunk.pop("_exemplar", None)
                 n_steps = int(
                     chunk["reward"].shape[0] * chunk["reward"].shape[1]
                 )
@@ -699,7 +745,7 @@ class SEEDTrainer:
                         )
                     else:
                         batch = jax.device_put(chunk)
-                return batch, versions, n_steps
+                return batch, versions, n_steps, lineage, exemplar
 
             prefetch = Prefetcher(stage_next_chunk, name="seed-stage")
 
@@ -707,6 +753,15 @@ class SEEDTrainer:
             discarded_steps = 0
             dp_event_emitted = False
             learn_ms: deque = deque(maxlen=256)  # learn-hop samples
+            # exact per-update staleness from the per-transition acting
+            # versions (ISSUE 14): host-side numpy reduction, replacing
+            # the ops plane's fanout-vs-fleet approximation
+            from surreal_tpu.session.telemetry import (
+                LineageReducer,
+                TraceContext,
+            )
+
+            lineage_reducer = LineageReducer()
 
             def data_plane_extras() -> dict:
                 """One source of truth for the drop/eviction/episode
@@ -727,7 +782,9 @@ class SEEDTrainer:
                 if f is not None:
                     state = faults.apply_trainer_fault(f, state)
                 with hooks.tracer.span("chunk-wait"):
-                    batch, versions, n_steps = prefetch.get()
+                    batch, versions, n_steps, lineage, exemplar = (
+                        prefetch.get()
+                    )
                 staleness = server.version - int(versions.min())
                 # Accounting contract: trainer-side stale DROPS count into
                 # env_steps (deterministic, the trainer chose to discard);
@@ -758,6 +815,21 @@ class SEEDTrainer:
                 with hooks.tracer.span("learn"):
                     state, metrics = self._learn(state, batch, lkey)
                 learn_ms.append((time.perf_counter() - t_learn0) * 1e3)
+                if exemplar is not None:
+                    # the adopted exemplar's final hop: THIS learn step
+                    # consumed the chunk the replica stamped — the tree
+                    # now spans gateway/worker -> replica -> learner
+                    hooks.tracer.emit_span(
+                        "learn.dispatch",
+                        TraceContext(
+                            exemplar["exemplar"],
+                            hooks.tracer.next_span_id(),
+                            exemplar["parent"],
+                        ),
+                        tier="learner",
+                        dur_ms=learn_ms[-1],
+                        version=int(server.version),
+                    )
                 # cost accounting, first learn only (idempotent; needs a
                 # representative staged chunk to lower)
                 hooks.record_program_costs(
@@ -783,6 +855,18 @@ class SEEDTrainer:
                 metrics = dict(
                     metrics,
                     **{"staleness/updates_behind": float(staleness)},
+                    # exact per-update staleness distribution + the span
+                    # counters; the ops plane's SLO staleness objective
+                    # prefers the lineage gauges over its derived
+                    # fanout-vs-fleet approximation when they are present
+                    **(
+                        lineage_reducer.reduce(server.version, versions)
+                        if self._lineage else {}
+                    ),
+                    **(
+                        hooks.tracer.trace_gauges()
+                        if self._trace_sample_n > 0 else {}
+                    ),
                     **data_plane_extras(),
                     # cached (last-cadence) plane gauges: the wire poll
                     # happens below at the cadence, not per iteration

@@ -866,6 +866,26 @@ def run_top(args) -> int:
         return 0
 
 
+def run_trace(args) -> int:
+    """Causal span-tree timelines for the head-sampled exemplars
+    (session/telemetry.py trace_report): one tree per exemplar, spans
+    correlated across tiers by trace/span ids, torn hops marked. Pure
+    file reading over the telemetry event log — no jax, no zmq — so it
+    works off-chip and against a live run, like ``diag``/``top``."""
+    from surreal_tpu.session.telemetry import trace_report
+
+    if not os.path.isdir(args.folder):
+        print(f"no session folder {args.folder!r}", file=sys.stderr)
+        return 2
+    report = trace_report(args.folder, limit=args.limit)
+    if report is None:
+        print(f"no telemetry under {args.folder!r} (is this a "
+              "session folder?)", file=sys.stderr)
+        return 2
+    print(report)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="surreal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -1012,6 +1032,15 @@ def main(argv=None) -> int:
     tp.add_argument("--interval", type=float, default=2.0,
                     help="refresh period in seconds (default 2)")
     tp.set_defaults(fn=run_top)
+
+    tr = sub.add_parser("trace", help="causal span-tree timelines for "
+                        "the head-sampled exemplars (gateway act -> "
+                        "replica forward -> learner dispatch), from the "
+                        "telemetry event log; torn hops marked")
+    tr.add_argument("folder", help="session folder (holds telemetry/)")
+    tr.add_argument("--limit", type=int, default=16,
+                    help="newest exemplars to render (default 16)")
+    tr.set_defaults(fn=run_trace)
 
     args = parser.parse_args(argv)
     # the --local-procs supervisor re-issues this exact command per rank

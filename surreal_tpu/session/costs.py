@@ -214,6 +214,32 @@ GAUGE_REGISTRY = {
         "incident, each freezing a flightrec/slo dump)."
     ),
     "slo/objectives": "objectives armed via session_config.slo.* targets.",
+    "lineage/staleness_p50": (
+        "exact per-update staleness median: p50 over (current version - "
+        "acting version) of every transition in the batch that entered "
+        "this gradient, from the collection-time lineage stamps. Host "
+        "numpy over the already-fetched version column — no device sync."
+    ),
+    "lineage/staleness_p99": (
+        "exact per-update staleness p99 over the batch's acting-policy "
+        "versions (the SLO plane's staleness objective prefers this over "
+        "the published-vs-held approximation when lineage is on)."
+    ),
+    "lineage/staleness_max": (
+        "oldest transition that entered this update, in version lags."
+    ),
+    "lineage/versions_per_batch": (
+        "distinct acting-policy versions mixed into this update's batch "
+        "(1 == perfectly on-policy data)."
+    ),
+    "trace/spans": (
+        "causal spans emitted so far by this process's tracer "
+        "(head-sampled exemplars, telemetry.trace.sample_n)."
+    ),
+    "trace/dropped_spans": (
+        "spans dropped by the trace.emit chaos site — counted, never "
+        "silent; the exemplar's tree renders with the torn hop marked."
+    ),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

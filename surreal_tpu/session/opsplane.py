@@ -156,6 +156,11 @@ class FlightRecorder:
         self._last_dump: dict[str, float] = {}
         self._on_event = on_event
         self.dumps = 0
+        # callable returning the tracer's last-K exemplar span trees
+        # (list of {exemplar, spans}); dumped as exemplars.jsonl so a
+        # post-mortem sees WHAT the system was doing per-request, not
+        # just aggregate gauges. None == tracing absent, nothing written
+        self.exemplar_source = None
 
     def record_snapshot(self, snap: dict) -> None:
         self._snaps.append(snap)
@@ -187,9 +192,20 @@ class FlightRecorder:
             with open(os.path.join(out, "events.jsonl"), "w") as f:
                 for ev in self._events:
                     f.write(json.dumps(ev, default=float) + "\n")
+            exemplars = []
+            if self.exemplar_source is not None:
+                try:
+                    exemplars = list(self.exemplar_source() or ())
+                except Exception:  # noqa: BLE001 — tracer must not kill a dump
+                    exemplars = []
+            if exemplars:
+                with open(os.path.join(out, "exemplars.jsonl"), "w") as f:
+                    for ex in exemplars:
+                        f.write(json.dumps(ex, default=float) + "\n")
             meta = {
                 "trigger": trigger, "t": time.time(),
                 "snapshots": len(self._snaps), "events": len(self._events),
+                "exemplars": len(exemplars),
             }
             with open(os.path.join(out, "meta.json"), "w") as f:
                 json.dump(meta, f, indent=2)
@@ -308,9 +324,21 @@ class OpsAggregator:
 
     # -- snapshot (learner thread, metrics cadence) --------------------------
     def _derived(self, tiers: dict) -> dict:
-        """Cross-tier derived measurements: parameter staleness = newest
-        published version minus the oldest version any fleet replica
-        still serves (None until both sides have reported)."""
+        """Cross-tier derived measurements. Staleness prefers the
+        learner's exact per-update lineage reduction (``lineage/
+        staleness_p99`` — measured over the versions that actually
+        entered the gradient) and only falls back to the PR-13
+        approximation (newest published version minus the oldest version
+        any fleet replica still serves) when lineage is disabled or the
+        learner has not reported yet. ``staleness_source`` records which
+        path fed the SLO evaluation."""
+        learner = tiers.get("learner", {}).get("row", {})
+        exact = (learner.get("gauges") or {}).get("lineage/staleness_p99")
+        if exact is not None:
+            return {
+                "staleness_updates": max(0, int(exact)),
+                "staleness_source": "lineage",
+            }
         fanout = tiers.get("param_fanout", {}).get("row", {})
         published = (fanout.get("gauges") or {}).get("version")
         if published is None:
@@ -323,7 +351,10 @@ class OpsAggregator:
                 held.append(int(v))
         if not held:
             return {}
-        return {"staleness_updates": max(0, int(published) - min(held))}
+        return {
+            "staleness_updates": max(0, int(published) - min(held)),
+            "staleness_source": "derived",
+        }
 
     def snapshot(self, iteration: int | None = None,
                  env_steps: int | None = None) -> dict:
@@ -349,8 +380,9 @@ class OpsAggregator:
                 if isinstance(st, dict):
                     merged_hops[hop] = st
         gw = rows.get("gateway", {}).get("body") or {}
+        derived = self._derived(tiers)
         slo_table, newly_exhausted = self.slo.evaluate(
-            gw.get("tenants") or {}, merged_hops, self._derived(tiers)
+            gw.get("tenants") or {}, merged_hops, derived
         )
         self._seq += 1
         snap = {
@@ -359,6 +391,7 @@ class OpsAggregator:
             "iteration": iteration, "env_steps": env_steps,
             "tiers": rows, "hops": merged_hops, "slo": slo_table,
             "slo_counters": self.slo.gauges(), "bad_frames": bad,
+            "derived": derived,
         }
         self.flightrec.record_snapshot(snap)
         self._write(snap)

@@ -33,6 +33,13 @@ line, ``t`` = unix seconds):
     {"type": "phases",    "t": ..., "step": ..., "phases":
         {"<phase>": {"count": N, "total_s": S, "max_ms": M}}}
     {"type": "span",      "t": ..., "name": "...", "dur_s": ...}
+                    (low-frequency side-band spans via span(emit=True);
+                     ISSUE 14 adds CAUSAL spans from Tracer.emit_span —
+                     the same type with {"exemplar": ..., "span": S,
+                     "parent": P, "tier": "...", "dur_ms": ...} — one
+                     head-sampled request's hop across tiers; the
+                     `surreal_tpu trace` CLI assembles them into
+                     per-exemplar span trees)
     {"type": "metrics",   "t": ..., "step": ..., "values": {...}}
     {"type": "compile_cache", "t": ..., "dir": "...", "hits": H,
      "misses": M}   (cumulative; written by SessionHooks when
@@ -153,6 +160,7 @@ import os
 import threading
 import time
 import uuid
+from collections import deque
 from contextlib import contextmanager
 
 TELEMETRY_DIR = "telemetry"
@@ -167,7 +175,8 @@ PROFILES_DIR = "profiles"  # <folder>/telemetry/profiles/<tag>/ captures
 EVENT_REGISTRY = {
     "session": "Tracer.__init__ (session/telemetry.py)",
     "phases": "Tracer.flush_phases (session/telemetry.py)",
-    "span": "Tracer.span(emit=True) side-bands (session/telemetry.py)",
+    "span": "Tracer.span(emit=True) side-bands + Tracer.emit_span causal "
+            "trace exemplars (session/telemetry.py)",
     "metrics": "Tracer.log_metrics (session/telemetry.py)",
     "heartbeat": "HeartbeatWriter (session/telemetry.py, own file)",
     "compile_cache": "SessionHooks compile-cache counters (launch/hooks.py)",
@@ -205,6 +214,91 @@ def latency_percentiles(samples) -> dict[str, float] | None:
     return {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99), "n": n}
 
 
+class TraceContext:
+    """One head-sampled request's position in its causal span tree
+    (ISSUE 14): the exemplar id names the tree, ``span_id`` this hop,
+    ``parent_id`` the hop that caused it. Pure data — emitters pass it
+    across tier boundaries (gateway -> fleet replica -> learner chunk)
+    and call :meth:`Tracer.emit_span` at each hop."""
+
+    __slots__ = ("exemplar", "span_id", "parent_id")
+
+    def __init__(self, exemplar: str, span_id: int,
+                 parent_id: int | None = None):
+        self.exemplar = str(exemplar)
+        self.span_id = int(span_id)
+        self.parent_id = None if parent_id is None else int(parent_id)
+
+    def child(self, span_id: int) -> "TraceContext":
+        return TraceContext(self.exemplar, span_id, self.span_id)
+
+
+def head_sampled(counter: int, sample_n: int) -> bool:
+    """The 1-in-N head-sampling rule shared by every trace emitter: the
+    FIRST request of a stream (counter 1) is always an exemplar, then
+    every ``sample_n``-th after it. ``sample_n <= 0`` disables."""
+    if sample_n <= 0:
+        return False
+    return (int(counter) - 1) % int(sample_n) == 0
+
+
+class LineageReducer:
+    """Exact per-update staleness from per-transition lineage stamps
+    (ISSUE 14 tentpole, piece 2): every transition carries the param
+    version that ACTED it; the reducer turns one update's version column
+    into the exact staleness distribution the SLO plane previously only
+    approximated from fanout-vs-fleet version gaps.
+
+    Transfer-guard discipline: the version column is already host memory
+    (the trainer pops it before ``device_put``) and the reduction is
+    ``np.unique`` + integer arithmetic — no device values are ever
+    touched, so the exact path adds zero device->host syncs.
+
+    Percentiles use the same exact-index formula as
+    :func:`latency_percentiles` (``xs[min(n-1, int(p*(n-1)+0.5))]``) over
+    the sorted staleness multiset, walked via version counts instead of
+    materializing 32k-element sorted lists — bit-matchable by hand."""
+
+    def __init__(self):
+        self.updates = 0
+        self.last: dict[str, float] = {}
+
+    def reduce(self, current_version: int, versions) -> dict[str, float]:
+        """One update's ``lineage/*`` gauges from its acting-version
+        column (any-shape host int array). Empty dict when the column is
+        empty (nothing consumed, nothing to claim)."""
+        import numpy as np
+
+        arr = np.asarray(versions).reshape(-1)
+        if arr.size == 0:
+            return {}
+        vals, counts = np.unique(arr.astype(np.int64), return_counts=True)
+        cur = int(current_version)
+        # staleness sorted ascending = current - version, versions walked
+        # DESCENDING; cumulative counts give the element at any exact index
+        stal = [int(cur - v) for v in vals[::-1]]
+        cnts = [int(c) for c in counts[::-1]]
+        n = int(arr.size)
+
+        def pct(p: float) -> int:
+            k = min(n - 1, int(p * (n - 1) + 0.5))
+            seen = 0
+            for s, c in zip(stal, cnts):
+                seen += c
+                if k < seen:
+                    return s
+            return stal[-1]
+
+        self.updates += 1
+        self.last = {
+            "lineage/staleness_p50": float(pct(0.50)),
+            "lineage/staleness_p99": float(pct(0.99)),
+            "lineage/staleness_max": float(stal[-1]),
+            "lineage/versions_per_batch": float(len(stal)),
+        }
+        return dict(self.last)
+
+
 class Tracer:
     """Span tracing + JSONL event log for one session (rank 0 owns it,
     exactly like the MetricsWriter; disabled tracers are free no-ops so
@@ -216,7 +310,8 @@ class Tracer:
 
     def __init__(self, folder: str | None, enabled: bool = True,
                  name: str = "train", trace_id: str | None = None,
-                 max_log_mb: float | None = None):
+                 max_log_mb: float | None = None,
+                 trace_sample_n: int = 64, trace_keep: int = 8):
         self.enabled = bool(enabled) and folder is not None
         self._lock = threading.Lock()
         self._phases: dict[str, list] = {}  # name -> [count, total_s, max_s]
@@ -239,6 +334,18 @@ class Tracer:
         # it to the components they spawn.
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
         self._seq = 0
+        # causal span trees (ISSUE 14): head-sample cadence every emitter
+        # shares, a run-unique span-id counter (all emitters are threads
+        # of the session process, handed THIS tracer as their span sink),
+        # the chaos-counted drop tally, and the last-K exemplar ring the
+        # flight recorder snapshots into its dumps
+        self.trace_sample_n = int(trace_sample_n)
+        self.dropped_spans = 0
+        self.spans_emitted = 0
+        self._span_ids = 0
+        self._recent_exemplars: "deque[dict]" = deque(
+            maxlen=max(1, int(trace_keep))
+        )
         # last flushed phase window ({name: {count, total_s, max_ms}}) —
         # the cost accountant (session/costs.py) derives the perf/* gauges
         # from it without re-reading the event log
@@ -327,6 +434,70 @@ class Tracer:
                 st[2] = max(st[2], dur)
             if emit:
                 self.event("span", name=name, dur_s=dur)
+
+    # -- causal trace exemplars (ISSUE 14) -----------------------------------
+    def next_span_id(self) -> int:
+        """A run-unique span id (every trace emitter is a thread of the
+        session process sharing this tracer, so one locked counter is
+        globally unique within a run's event log)."""
+        with self._lock:
+            self._span_ids += 1
+            return self._span_ids
+
+    def trace_context(self, exemplar: str) -> TraceContext:
+        """Mint a ROOT context for a newly head-sampled request."""
+        return TraceContext(exemplar, self.next_span_id(), None)
+
+    def emit_span(self, name: str, ctx: TraceContext, *,
+                  tier: str | None = None, dur_ms: float | None = None,
+                  **fields) -> None:
+        """Emit one causal ``span`` event for hop ``ctx`` of its exemplar
+        tree. The ``trace.emit`` chaos site fires here: ``drop_span``
+        swallows the event but COUNTS it (``trace/dropped_spans``) and the
+        span id stays allocated, so children still reference the missing
+        hop and the trace CLI renders the tear instead of hiding it;
+        ``delay`` stalls the emit (spans are side-band — a slow emit must
+        never be mistaken for a slow hop, so callers pass dur_ms measured
+        BEFORE calling)."""
+        if not self.enabled:
+            return
+        from surreal_tpu.utils import faults
+
+        f = faults.fire("trace.emit")
+        if f is not None:
+            if f["kind"] == "drop_span":
+                with self._lock:
+                    self.dropped_spans += 1
+                return
+            if f["kind"] == "delay":
+                faults.sleep_ms(f)
+        rec = {
+            "name": name, "exemplar": ctx.exemplar, "span": ctx.span_id,
+            "parent": ctx.parent_id, **fields,
+        }
+        if tier is not None:
+            rec["tier"] = tier
+        if dur_ms is not None:
+            rec["dur_ms"] = float(dur_ms)
+        with self._lock:
+            self.spans_emitted += 1
+            self._recent_exemplars.append(dict(rec, t=time.time()))
+        self.event("span", **rec)
+
+    def trace_gauges(self) -> dict[str, float]:
+        """The ``trace/*`` gauge family (GAUGE_REGISTRY documents each);
+        merged into the learner's metrics row each cadence."""
+        return {
+            "trace/spans": float(self.spans_emitted),
+            "trace/dropped_spans": float(self.dropped_spans),
+        }
+
+    def recent_exemplar_spans(self) -> list[dict]:
+        """The last-K exemplar span records (newest last) — the flight
+        recorder writes them into every dump so a frozen incident carries
+        the requests that flew through it."""
+        with self._lock:
+            return [dict(r) for r in self._recent_exemplars]
 
     def flush_phases(self, step) -> dict[str, float]:
         """Write one ``phases`` event for the window since the last flush
@@ -589,7 +760,10 @@ def diag_summary(folder: str) -> dict | None:
             last_step = ev.get("step", last_step)
             vals = ev.get("values") or {}
             for k, v in vals.items():
-                if k.startswith("perf/") and isinstance(v, (int, float)):
+                if (
+                    k.startswith(("perf/", "lineage/", "trace/"))
+                    and isinstance(v, (int, float))
+                ):
                     perf_last[k] = v
             if vals.get("health/nonfinite", 0):
                 nonfinite_windows += 1
@@ -1046,6 +1220,19 @@ def _performance_lines(s: dict) -> list[str]:
                 f"flops/s {perf['perf/flops_per_s'] / 1e9:.2f} G"
             )
         lines.append("  gauges (last metrics row): " + ", ".join(bits))
+    lin_p50 = perf.get("lineage/staleness_p50")
+    if lin_p50 is not None:
+        lines.append(
+            "  lineage (exact per-update staleness, in updates): "
+            f"p50 {lin_p50:g}, p99 {perf.get('lineage/staleness_p99', 0):g}, "
+            f"max {perf.get('lineage/staleness_max', 0):g}, "
+            f"{perf.get('lineage/versions_per_batch', 0):g} version(s)/batch"
+        )
+    if perf.get("trace/spans"):
+        lines.append(
+            f"  trace exemplars: {perf['trace/spans']:g} span(s) emitted, "
+            f"{perf.get('trace/dropped_spans', 0):g} dropped (chaos)"
+        )
     if hops:
         lines.append("  per-hop latency (cross-process timeline):")
         for hop in sorted(hops):
@@ -1068,3 +1255,128 @@ def _performance_lines(s: dict) -> list[str]:
                 )
             )
     return lines
+
+
+# -- trace (causal span trees, ISSUE 14) --------------------------------------
+
+
+def trace_summary(folder: str) -> dict | None:
+    """Collect every causal span event (the ones ``Tracer.emit_span``
+    stamps with an ``exemplar`` id) from the session's event log into
+    per-exemplar groups. Pure file reading — no jax, safe off-chip. None
+    when no event log exists."""
+    events_path = os.path.join(folder, TELEMETRY_DIR, EVENTS_FILE)
+    if not (os.path.exists(events_path)
+            or os.path.exists(events_path + ".1")):
+        return None
+    exemplars: dict[str, list[dict]] = {}
+    trace_id = None
+    dropped = spans = None
+    for ev in _iter_jsonl(events_path):
+        if trace_id is None and ev.get("trace"):
+            trace_id = ev["trace"]
+        if ev.get("type") == "span" and ev.get("exemplar"):
+            exemplars.setdefault(str(ev["exemplar"]), []).append(ev)
+        elif ev.get("type") == "metrics":
+            vals = ev.get("values") or {}
+            if "trace/dropped_spans" in vals:
+                dropped = vals["trace/dropped_spans"]
+            if "trace/spans" in vals:
+                spans = vals["trace/spans"]
+    return {
+        "folder": folder,
+        "trace_id": trace_id,
+        "exemplars": exemplars,
+        "spans": spans,
+        "dropped_spans": dropped,
+    }
+
+
+def _render_exemplar(spans: list[dict]) -> list[str]:
+    """One exemplar's span tree, children indented under parents, ordered
+    by wall time within a level. A span whose parent id was never emitted
+    (chaos ``drop_span``, a crashed tier) is NOT hidden: it renders as a
+    root with the missing hop marked — a torn tree is evidence."""
+    by_id = {int(s["span"]): s for s in spans if s.get("span") is not None}
+    kids: dict[int | None, list[dict]] = {}
+    for s in sorted(spans, key=lambda x: (x.get("t", 0), x.get("seq", 0))):
+        parent = s.get("parent")
+        if parent is not None and int(parent) not in by_id:
+            parent = ("missing", int(parent))  # torn: render as a root
+        elif parent is not None:
+            parent = int(parent)
+        kids.setdefault(parent, []).append(s)
+    t0 = min((s.get("t", 0) for s in spans), default=0)
+    lines: list[str] = []
+
+    def emit(s: dict, depth: int, missing_parent: int | None) -> None:
+        dur = s.get("dur_ms")
+        rel = (s.get("t", t0) - t0) * 1e3
+        lines.append(
+            f"  {'  ' * depth}[+{rel:8.2f} ms] {s.get('name', '?'):<22} "
+            f"span {s.get('span')}  tier {s.get('tier', '?')}"
+            + (f"  {float(dur):.3f} ms" if dur is not None else "")
+            + (
+                f"  !! parent span {missing_parent} MISSING "
+                "(dropped/torn hop)" if missing_parent is not None else ""
+            )
+        )
+        for child in kids.get(int(s["span"]), []) if s.get("span") is not None else []:
+            emit(child, depth + 1, None)
+
+    for root in kids.get(None, []):
+        emit(root, 0, None)
+    for parent_key in sorted(
+        (k for k in kids if isinstance(k, tuple)),
+        key=lambda k: k[1],
+    ):
+        for orphan in kids[parent_key]:
+            emit(orphan, 0, parent_key[1])
+    return lines
+
+
+def trace_report(folder: str, limit: int = 16) -> str | None:
+    """Human-readable causal trace timelines for ``surreal_tpu trace``:
+    one span tree per head-sampled exemplar, newest last, torn hops
+    marked. None when the folder has no telemetry."""
+    s = trace_summary(folder)
+    if s is None:
+        return None
+    exemplars = s["exemplars"]
+    header = f"Causal trace exemplars — {s['folder']}"
+    if s.get("trace_id"):
+        header += f" (trace {s['trace_id']})"
+    lines = [header]
+    total = sum(len(v) for v in exemplars.values())
+    summary = f"{len(exemplars)} exemplar(s), {total} span event(s)"
+    if s.get("dropped_spans"):
+        summary += (
+            f"; {s['dropped_spans']:g} span(s) DROPPED by chaos — "
+            "trees below may be torn"
+        )
+    lines.append(summary)
+    if not exemplars:
+        lines.append("  (no causal spans recorded — telemetry.trace "
+                     "disabled or nothing sampled yet)")
+        return "\n".join(lines)
+    ordered = sorted(
+        exemplars.items(),
+        key=lambda kv: min(s.get("t", 0) for s in kv[1]),
+    )
+    if len(ordered) > limit:
+        lines.append(f"  (showing oldest {limit} of {len(ordered)})")
+        ordered = ordered[:limit]
+    for name, spans in ordered:
+        tiers = []
+        for sp in sorted(spans, key=lambda x: (x.get("t", 0),
+                                               x.get("seq", 0))):
+            tier = sp.get("tier", "?")
+            if tier not in tiers:
+                tiers.append(tier)
+        lines.append("")
+        lines.append(
+            f"exemplar {name} — {len(spans)} span(s), tiers: "
+            + " -> ".join(tiers)
+        )
+        lines += _render_exemplar(spans)
+    return "\n".join(lines)

@@ -79,6 +79,15 @@ Sites and the kinds they honor:
                          counts the drop and the aggregator's per-tier
                          age turns DEAD if the tier stays silent;
                          ``delay``: sleep ``ms`` first)
+    trace.emit           every causal span emit (Tracer.emit_span,
+                         session/telemetry.py)
+                         (``drop_span``: swallow the span event — counted
+                         in ``trace/dropped_spans``, and the exemplar's
+                         tree renders TORN in `surreal_tpu trace` (the
+                         missing hop marked) instead of silently complete;
+                         ``delay``: sleep ``ms`` before the emit — spans
+                         are side-band, so a slow emit must never shift a
+                         hop's measured duration)
     gateway.session      once per gateway serve-loop pass
                          (``drop_frame``: swallow the act reply frame —
                          the client's bounded resend redelivers against
@@ -129,6 +138,7 @@ SITES = frozenset(
         "param.publish",
         "gateway.session",
         "ops.push",
+        "trace.emit",
     }
 )
 

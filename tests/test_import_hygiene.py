@@ -363,10 +363,12 @@ def test_no_swallowed_exceptions_in_supervised_code():
 def test_perf_gauges_appear_in_registry():
     """Gauge-registry lint (ISSUE 6 satellite, extended by ISSUE 8 over
     the replay/experience families, ISSUE 10 over the serving-tier
-    fleet/param families, ISSUE 12 over the gateway family, and ISSUE 13
-    over the ops/slo families): every
+    fleet/param families, ISSUE 12 over the gateway family, ISSUE 13
+    over the ops/slo families, and ISSUE 14 over the lineage/trace
+    families): every
     ``perf/*``, ``replay/*``, ``experience/*``, ``fleet/*``,
-    ``param/*``, ``gateway/*``, ``ops/*``, or ``slo/*`` gauge name emitted
+    ``param/*``, ``gateway/*``, ``ops/*``, ``slo/*``, ``lineage/*``, or
+    ``trace/*`` gauge name emitted
     anywhere in the package must appear in the documented registry
     (``session/costs.py::GAUGE_REGISTRY``) — an undocumented gauge is
     invisible to diag readers and to the README's knob table. The scan
@@ -378,7 +380,8 @@ def test_perf_gauges_appear_in_registry():
     from surreal_tpu.session.costs import GAUGE_REGISTRY
 
     lit = re.compile(
-        r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo)"
+        r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo"
+        r"|lineage|trace)"
         r"/[a-z0-9_]+)[\"']"
     )
     bad = []
@@ -393,7 +396,8 @@ def test_perf_gauges_appear_in_registry():
                     f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
                 )
     assert not bad, (
-        "perf/replay/experience/fleet/param/gateway/ops/slo gauges emitted "
+        "perf/replay/experience/fleet/param/gateway/ops/slo/lineage/trace "
+        "gauges emitted "
         "but not documented in session/costs.py::GAUGE_REGISTRY:\n"
         + "\n".join(bad)
     )
@@ -401,7 +405,7 @@ def test_perf_gauges_appear_in_registry():
     for name in GAUGE_REGISTRY:
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
-             "gateway/", "ops/", "slo/")
+             "gateway/", "ops/", "slo/", "lineage/", "trace/")
         ), name
 
 

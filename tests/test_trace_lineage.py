@@ -1,0 +1,424 @@
+"""Causal trace exemplars + experience lineage (ISSUE 14): the shared
+head-sampling rule, the bit-matchable exact staleness reduction, chaos-
+dropped spans counted and rendered as torn (never silently complete),
+pre-caps/pre-lineage wire compatibility against the new gateway and
+shard (hellos declare capabilities, never require them), the SLO plane
+preferring the exact lineage staleness over the derived approximation,
+exemplar spans riding flight-recorder dumps, and the chaos e2e: a live
+SEED run with an external gateway tenant whose head-sampled act spans
+correlate across gateway -> fleet replica -> learner-side hops by
+trace/span ids, rendered by ``surreal_tpu trace``."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+from surreal_tpu.session.telemetry import (
+    LineageReducer,
+    TraceContext,
+    Tracer,
+    head_sampled,
+    trace_report,
+    trace_summary,
+)
+from surreal_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    faults.configure(None)  # never leak a plan into the next test
+
+
+# -- head sampling + exact staleness ------------------------------------------
+
+def test_head_sampled_rule_first_then_every_nth():
+    assert head_sampled(1, 64)          # the FIRST request is always sampled
+    assert not head_sampled(2, 64)
+    assert head_sampled(65, 64)
+    assert head_sampled(129, 64)
+    assert all(head_sampled(c, 1) for c in range(1, 5))
+    assert not head_sampled(1, 0)       # 0 disables
+    assert not head_sampled(1, -3)
+
+
+def test_lineage_reducer_bit_matches_hand_computed_distribution():
+    """The acceptance arithmetic, by hand: a 16-transition batch acted by
+    versions [40 x 10, 39 x 3, 37 x 2, 35 x 1] against current version
+    41. Sorted staleness multiset ascending:
+    [1]*10 + [2]*3 + [4]*2 + [6]*1 (n=16). Exact index k =
+    min(n-1, int(p*(n-1)+0.5)): p50 -> k=8 -> 1; p99 -> k=15 -> 6."""
+    versions = np.asarray(
+        [40] * 10 + [39] * 3 + [37] * 2 + [35], np.int32
+    ).reshape(4, 4)  # any shape: the reducer flattens
+    g = LineageReducer().reduce(41, versions)
+    assert g["lineage/staleness_p50"] == 1.0
+    assert g["lineage/staleness_p99"] == 6.0
+    assert g["lineage/staleness_max"] == 6.0
+    assert g["lineage/versions_per_batch"] == 4.0
+    # single-version batch: perfectly on-policy, all-zero staleness
+    g = LineageReducer().reduce(7, np.full(32, 7, np.int64))
+    assert g["lineage/staleness_p50"] == 0.0
+    assert g["lineage/staleness_max"] == 0.0
+    assert g["lineage/versions_per_batch"] == 1.0
+    # empty column: nothing consumed, nothing claimed
+    assert LineageReducer().reduce(7, np.zeros((0,), np.int32)) == {}
+
+
+def test_lineage_reduction_is_guard_clean_no_device_syncs():
+    """The reduction runs on the host-side versions column the trainer
+    pops BEFORE device_put — proven under the transfer guard: exact
+    staleness adds zero device->host syncs to the train loop."""
+    import jax
+
+    versions = np.repeat(np.asarray([37, 38, 39, 40], np.int32), 8)
+    with jax.transfer_guard_device_to_host("disallow"):
+        g = LineageReducer().reduce(41, versions)
+    assert g["lineage/versions_per_batch"] == 4.0
+
+
+# -- chaos: dropped spans counted, torn trees rendered ------------------------
+
+def test_chaos_dropped_span_is_counted_and_tree_renders_torn(tmp_path):
+    folder = str(tmp_path)
+    faults.configure([  # "at" is the 0-based call index: drop emit #2
+        {"site": "trace.emit", "kind": "drop_span", "at": 1, "times": 1}
+    ])
+    tracer = Tracer(folder, enabled=True, name="test", trace_sample_n=1)
+    try:
+        root = tracer.trace_context("ex:torn")
+        tracer.emit_span("gateway.act", root, tier="gateway", dur_ms=1.0)
+        mid = root.child(tracer.next_span_id())
+        # chaos swallows THIS hop — the span id stays allocated, so the
+        # child below references a hop the log never received
+        tracer.emit_span("replica.forward", mid, tier="fleet.replica0")
+        leaf = mid.child(tracer.next_span_id())
+        tracer.emit_span("learn.dispatch", leaf, tier="learner")
+    finally:
+        tracer.close()
+    assert tracer.trace_gauges() == {
+        "trace/spans": 2.0, "trace/dropped_spans": 1.0
+    }
+    report = trace_report(folder)
+    assert report is not None and "ex:torn" in report
+    assert "MISSING" in report, "torn hop must be marked, not hidden"
+    assert "learn.dispatch" in report  # the orphaned child still renders
+
+
+def test_flight_recorder_dump_carries_recent_exemplars(tmp_path):
+    from surreal_tpu.session.opsplane import FlightRecorder
+
+    tracer = Tracer(str(tmp_path), enabled=True, name="t", trace_sample_n=1)
+    try:
+        ctx = tracer.trace_context("ex:rec")
+        tracer.emit_span("gateway.act", ctx, tier="gateway", dur_ms=0.5)
+    finally:
+        tracer.close()
+    rec = FlightRecorder(str(tmp_path), ring=4)
+    rec.exemplar_source = tracer.recent_exemplar_spans
+    rec.record_snapshot({"type": "ops_snapshot", "seq": 1})
+    out = rec.dump("fault")
+    assert out is not None
+    with open(os.path.join(out, "exemplars.jsonl")) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    assert rows and rows[0]["exemplar"] == "ex:rec"
+    with open(os.path.join(out, "meta.json")) as f:
+        assert json.load(f)["exemplars"] == 1
+
+
+# -- wire compatibility: capabilities are declared, never required ------------
+
+def test_experience_hello_caps_ride_json_and_pre_caps_peer_decodes():
+    from surreal_tpu.experience import wire
+
+    spec = wire.PlaneSpec.from_example({"obs": np.zeros(3, np.float32)})
+    kind, obj = wire.decode_payload(
+        wire.encode_hello("sender", spec, 16, 4, "tcp", caps=("lineage",))
+    )
+    assert kind == "hello" and obj["caps"] == ["lineage"]
+    # a pre-lineage peer's hello has NO caps key at all — strip it from
+    # the JSON and replay: the new decoder must hand back a dict the
+    # shard's ``info.get("caps")`` path reads as empty, no struct.error
+    frame = wire.encode_hello("sender", spec, 16, 4, "tcp")
+    head, payload = frame[:5], frame[5:]
+    obj_old = json.loads(payload)
+    del obj_old["caps"]
+    kind, obj = wire.decode_payload(head + json.dumps(obj_old).encode())
+    assert kind == "hello"
+    assert set(obj.get("caps") or ()) == set()
+
+
+def test_pre_lineage_sender_ingests_and_samples_against_new_shard(monkeypatch):
+    """A pre-lineage sender (its hello carries no "caps" key) replayed
+    against the new shard: attach, ingest, and sampling all work — the
+    capability seam is additive, never load-bearing."""
+    import jax
+
+    from surreal_tpu.experience import wire
+    from surreal_tpu.experience.plane import ExperiencePlane
+
+    orig = wire.encode_hello
+
+    def pre_caps_hello(*args, **kw):
+        kw.pop("caps", None)
+        frame = orig(*args, **kw)
+        obj = json.loads(bytes(frame[5:]))
+        obj.pop("caps", None)
+        return frame[:5] + json.dumps(obj).encode()
+
+    monkeypatch.setattr(wire, "encode_hello", pre_caps_hello)
+    example = {"obs": np.zeros(3, np.float32)}
+    plane = ExperiencePlane(
+        kind="uniform", example=example, capacity=64, batch_size=8,
+        start_sample_size=1, updates_per_iter=1, num_slots=4,
+        max_insert_rows=16,
+        cfg={"num_shards": 1, "shard_mode": "thread", "transport": "tcp",
+             "ack_timeout_s": 1.0, "sample_timeout_s": 2.0,
+             "watermark_timeout_s": 1.0},
+        base_key=jax.random.key(3), prefetch=False, device_put=False,
+    )
+    try:
+        rng = np.random.default_rng(0)
+        rows = {"obs": rng.normal(size=(12, 3)).astype(np.float32)}
+        wm = plane.sender.send_rows(rows, np.arange(12) % 4)
+        batch, info = plane.sampler.fetch_batch(jax.random.key(1), 0.0, wm)
+        assert batch["obs"].shape == (8, 3)
+    finally:
+        plane.close()
+
+
+def test_pre_caps_gateway_session_serves_without_spans_and_caps_enable_them():
+    """A pre-caps GHELLO (no "caps" key at all) against the new gateway
+    with tracing ARMED: attach + act succeed and no span is minted for
+    that session; a session that negotiated the "trace" cap on the same
+    server gets a gateway.act root span whose exemplar correlates with
+    the replica.forward child by trace/parent ids."""
+    from surreal_tpu.distributed.fleet import InferenceFleet
+    from surreal_tpu.gateway import GatewayServer, GatewaySession
+    from surreal_tpu.gateway import protocol as gw
+
+    def act_fn(obs):
+        b = obs.shape[0]
+        return np.zeros(b, np.int64), {}
+
+    spans: list[tuple[str, dict]] = []
+
+    class _Sink:
+        """In-memory span sink with the Tracer's emitter surface."""
+
+        def __init__(self):
+            self._ids = 0
+
+        def next_span_id(self):
+            self._ids += 1
+            return self._ids
+
+        def trace_context(self, exemplar):
+            return TraceContext(exemplar, self.next_span_id(), None)
+
+        def emit_span(self, name, ctx, **fields):
+            spans.append((name, {
+                "exemplar": ctx.exemplar, "span": ctx.span_id,
+                "parent": ctx.parent_id, **fields,
+            }))
+
+    fleet = InferenceFleet(act_fn, num_workers=2, replicas=2,
+                           unroll_length=4, span_sink=_Sink(),
+                           trace_sample_n=1)
+    server = GatewayServer(fleet, lease_s=30.0, span_sink=fleet._span_sink,
+                           trace_sample_n=1)
+    try:
+        obs = np.arange(8, dtype=np.float32).reshape(2, 4)
+        # arm 1: the pre-caps peer (old client binary)
+        orig = gw.encode_hello
+
+        def pre_caps_hello(*args, **kw):
+            kw.pop("caps", None)
+            frame = orig(*args, **kw)
+            obj = json.loads(frame[5:])
+            obj.pop("caps", None)
+            return frame[:5] + json.dumps(obj).encode()
+
+        gw.encode_hello = pre_caps_hello
+        try:
+            old = GatewaySession(server.address, tenant="old", obs_shape=(2, 4))
+        finally:
+            gw.encode_hello = orig
+        a, info = old.act(obs)
+        assert a.shape == (2,)
+        assert not spans, "a pre-caps session must never mint spans"
+        old.close()
+        # arm 2: the new client declares ("trace",) by default
+        new = GatewaySession(server.address, tenant="new", obs_shape=(2, 4))
+        a, info = new.act(obs * 2)
+        assert a.shape == (2,)
+        new.close()
+    finally:
+        server.close()
+        fleet.close()
+    names = [n for n, _ in spans]
+    assert "gateway.act" in names and "replica.forward" in names
+    root = next(f for n, f in spans if n == "gateway.act")
+    fwd = next(f for n, f in spans if n == "replica.forward")
+    assert root["tier"] == "gateway" and root["parent"] is None
+    assert fwd["tier"].startswith("fleet.replica")
+    assert fwd["exemplar"] == root["exemplar"]
+    assert fwd["parent"] == root["span"]  # child of the gateway root
+
+
+# -- SLO plane: exact staleness preferred over the approximation --------------
+
+def test_derived_staleness_prefers_exact_lineage_and_slo_consumes_it(tmp_path):
+    from surreal_tpu.session.opsplane import OpsAggregator
+
+    agg = OpsAggregator(
+        str(tmp_path), trace_id="t", cfg={"enabled": False},
+        slo_cfg={"staleness_updates": 2.0, "budget_windows": 4,
+                 "budget": 0.5},
+    )
+    try:
+        agg.push_local("param_fanout", gauges={"version": 50.0})
+        agg.push_local("fleet", body={"replicas": {
+            "0": {"alive": True, "param_version": 49}
+        }})
+        agg.push_local("gateway", body={"tenants": {"alpha": {"acts": 1}}})
+        # no learner row yet: the PR-13 approximation carries the SLO
+        snap = agg.snapshot(iteration=1)
+        assert snap["derived"] == {
+            "staleness_updates": 1, "staleness_source": "derived"
+        }
+        # the learner's exact reduction lands: it REPLACES the
+        # approximation (and here contradicts it — 4 > target 2, so the
+        # exact path is what breaches, provably evaluated)
+        agg.push_local("learner", gauges={"lineage/staleness_p99": 4.0})
+        snap = agg.snapshot(iteration=2)
+        assert snap["derived"] == {
+            "staleness_updates": 4, "staleness_source": "lineage"
+        }
+        row = snap["slo"]["alpha"]["staleness_updates"]
+        assert row["measured"] == 4.0 and row["breached"]
+    finally:
+        agg.close()
+
+
+# -- the chaos e2e acceptance run ---------------------------------------------
+
+def test_trace_lineage_chaos_e2e(tmp_path):
+    """A live SEED run (workers + 2-replica fleet + gateway) with an
+    external tenant and a trace.emit chaos drop: the run finishes with
+    exact lineage gauges in its metrics, at least one exemplar whose
+    spans correlate across >= 3 tiers (gateway -> fleet replica ->
+    learner-side hop) by trace/span ids, the dropped span counted, and
+    ``surreal_tpu trace`` rendering the timelines."""
+    import zmq
+
+    from surreal_tpu.gateway import GatewayError, GatewaySession
+    from surreal_tpu.launch.seed_trainer import SEEDTrainer
+    from surreal_tpu.main.launch import main
+
+    folder = str(tmp_path)
+    cfg = Config(
+        learner_config=Config(algo=Config(name="impala", horizon=8)),
+        env_config=Config(name="gym:CartPole-v1", num_envs=4),
+        session_config=Config(
+            folder=folder,
+            total_env_steps=400,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=0),
+            eval=Config(every_n_iters=0),
+            telemetry=Config(trace=Config(sample_n=1, keep=8)),
+            topology=Config(
+                num_env_workers=2,
+                inference_fleet=Config(replicas=2),
+                gateway=Config(enabled=True, lease_s=10.0),
+            ),
+            faults=Config(plan=[
+                {"site": "trace.emit", "kind": "drop_span", "at": 5,
+                 "times": 1},
+            ]),
+        ),
+    ).extend(base_config())
+    trainer = SEEDTrainer(cfg)
+    tenant_acts: list[int] = []
+    tenant_errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def tenant_loop():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            gateway = getattr(trainer, "_gateway", None)
+            if gateway is not None:
+                break
+            time.sleep(0.1)
+        else:
+            return
+        sess = GatewaySession(
+            gateway.address, tenant="external", obs_shape=(1, 4),
+            timeout_s=10.0, retries=3,
+        )
+        while not stop.is_set():
+            try:
+                actions, info = sess.act(
+                    np.random.rand(1, 4).astype(np.float32)
+                )
+            except (TimeoutError, GatewayError) as e:
+                gw_srv = getattr(trainer, "_gateway", None)
+                if not stop.is_set() and gw_srv is not None and gw_srv.alive:
+                    tenant_errors.append(e)
+                return
+            tenant_acts.append(int(info["param_version"]))
+            time.sleep(0.05)
+        try:
+            sess.close()
+        except zmq.ZMQError:
+            pass
+
+    t = threading.Thread(target=tenant_loop, daemon=True)
+    t.start()
+    try:
+        state, metrics = trainer.run()
+    finally:
+        stop.set()
+        t.join(timeout=15)
+
+    assert metrics["time/env_steps"] >= 400
+    assert tenant_acts, "the external tenant never got an act served"
+    assert not tenant_errors, f"tenant session lost: {tenant_errors!r}"
+    # exact per-update lineage staleness rode the metrics row
+    assert metrics["lineage/staleness_p50"] >= 0.0
+    assert metrics["lineage/staleness_p99"] >= metrics["lineage/staleness_p50"]
+    assert metrics["lineage/versions_per_batch"] >= 1.0
+    # spans were emitted; the chaos drop was counted, never silent
+    assert metrics["trace/spans"] > 0.0
+    assert metrics["trace/dropped_spans"] >= 1.0
+    s = trace_summary(folder)
+    assert s is not None and s["exemplars"], "no exemplar span trees logged"
+    # >= 3 tiers correlated by trace/span ids on at least one exemplar:
+    # gateway root or worker root -> fleet replica forward -> the
+    # learner-side hop (experience relay / learn dispatch)
+    best = max(
+        (
+            {sp.get("tier") for sp in spans}
+            for spans in s["exemplars"].values()
+        ),
+        key=len,
+    )
+    learner_side = {"learner", "experience"}
+    assert any(tier and tier.startswith("fleet.replica") for tier in best)
+    assert best & learner_side, f"no learner-side hop in any tree: {best}"
+    assert len(best) >= 3, f"widest exemplar spans only tiers {best}"
+    # the gateway tier correlated on some exemplar too (tenant-side root)
+    all_tiers = {
+        sp.get("tier")
+        for spans in s["exemplars"].values() for sp in spans
+    }
+    assert "gateway" in all_tiers
+    # and the CLI renders it
+    assert main(["trace", folder]) == 0
+    assert main(["trace", folder, "--limit", "2"]) == 0
