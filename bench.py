@@ -309,6 +309,14 @@ def main() -> int:
         from perf_wallclock import trace_main
 
         return trace_main(sys.argv[1:])
+    if "--watchdog" in sys.argv:
+        # watchdog/incident campaign (ISSUE 15): detector sweep +
+        # incident-engine observe cost per snapshot, incident-open e2e
+        # latency — writes BENCH_watchdog.json (perf_gate's watchdog
+        # gate consumes it)
+        from perf_wallclock import watchdog_main
+
+        return watchdog_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

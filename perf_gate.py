@@ -487,6 +487,48 @@ def gate_trace(art_dir: str, out=sys.stdout) -> int:
     return 0
 
 
+def gate_watchdog(art_dir: str, out=sys.stdout) -> int:
+    """The watchdog overhead commitment (ISSUE 15), from
+    ``BENCH_watchdog.json`` (``python bench.py --watchdog``): one full
+    detector sweep (all five families armed at the production tier
+    census) plus the incident engine's per-sweep observe, priced at the
+    measured p99, must cost <= ``eval_frac_max`` (1%) of one
+    steady-state train iteration at the committed headline geometry —
+    the watchdog judges the workload, it must never become one.
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_watchdog.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_watchdog.json — watchdog not measured "
+              "(rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_watchdog.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    # default mirrors the producer's bound (perf_wallclock.py
+    # WATCHDOG_EVAL_FRAC_MAX) so a field-less artifact can't flip the
+    # verdict
+    frac_max = float(data.get("eval_frac_max", 0.01))
+    frac = data.get("eval_frac_of_iter", data.get("value"))
+    iter_ms = data.get("iter_ms")
+    line = (
+        f"perf_gate: watchdog sweep+incident p99 {float(frac):.3%} of "
+        "the iteration"
+        + (f" ({float(iter_ms):.1f} ms)" if iter_ms is not None else "")
+        + f", commitment <= {frac_max:.0%}"
+    )
+    if float(frac) > frac_max:
+        print(line + " — THE WATCHDOG BECAME THE WORKLOAD", file=out)
+        return 1
+    print(line + " — ok", file=out)
+    return 0
+
+
 def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
     committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
@@ -547,13 +589,15 @@ def gate_tier1(art_dir: str, out=sys.stdout) -> int:
 
 
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
-    # the experience-plane, act-path, gateway, ops-plane, trace, and
-    # tier-1 budget gates are independent of the BENCH_r* trail: run
-    # them first and fold their verdicts into every return path
+    # the experience-plane, act-path, gateway, ops-plane, trace,
+    # watchdog, and tier-1 budget gates are independent of the BENCH_r*
+    # trail: run them first and fold their verdicts into every return
+    # path
     xp_rc = max(
         gate_experience(art_dir, out=out), gate_act(art_dir, out=out),
         gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
-        gate_trace(art_dir, out=out), gate_tier1(art_dir, out=out),
+        gate_trace(art_dir, out=out), gate_watchdog(art_dir, out=out),
+        gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

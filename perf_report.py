@@ -1458,6 +1458,94 @@ def _trace_lines() -> list[str]:
     return lines
 
 
+def _load_watchdog_bench():
+    """Load the watchdog artifact (``BENCH_watchdog.json``, written by
+    ``bench.py --watchdog``) if present — same BENCH_host.json
+    discipline: PERF.md regens preserve the measured section without
+    re-running the campaign."""
+    try:
+        with open("BENCH_watchdog.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or data.get("value") is None:
+        return None  # failed-campaign artifact
+    return data
+
+
+def _watchdog_lines() -> list[str]:
+    """The 'Watchdog & incidents' PERF.md section: static mechanism text
+    plus the measured sweep-cost table from the BENCH_watchdog.json
+    artifact. One function so ``main()`` and the committed PERF.md
+    cannot drift."""
+    lines = [
+        "",
+        "## Watchdog & incident engine",
+        "",
+        "PRs 13-14 collect; ISSUE 15 interprets. `session/watchdog.py` "
+        "runs a detector sweep over every merged ops snapshot (the "
+        "metrics cadence): robust median/MAD breakouts on the headline "
+        "latencies and throughputs (iteration time, env steps/s, "
+        "sample-wait, gateway act-RTT p99, fleet serve), queue/"
+        "backpressure saturation and respawn-rate bursts, monotonic "
+        "growth of every counted-never-silent `*dropped*`/`*bad_frames` "
+        "counter plus the `lineage/staleness_p99` ramp, tier liveness "
+        "from the ops plane's DEAD rendering, and online regression "
+        "against the committed BENCH baseline for the live platform "
+        "fingerprint (`perf_gate.load_rows`). Firings feed "
+        "`session/incidents.py`, which opens root-caused incidents: "
+        "evidence correlated in a bounded window (chaos faults, "
+        "recovery trips, SLO breaches, slowest exemplar spans, dead "
+        "tiers), cause hypotheses ranked upstream-first over the static "
+        "tier dataflow graph, one auto-captured profiler window + "
+        "flight-recorder dump per incident (cooldown-bounded), closed "
+        "only on sustained-healthy windows. `surreal_tpu why <folder>` "
+        "renders the records (pure file reading, like `top`/`trace`); "
+        "every sweep is pure host arithmetic over the snapshot dict — "
+        "zero added device->host syncs (transfer-guard tested).",
+    ]
+    wd = _load_watchdog_bench()
+    if wd:
+        ev = wd.get("eval_ms") or {}
+        lines += [
+            "",
+            f"Measured at the production census ({wd.get('workload', 'benchmark workload')}; "
+            f"`BENCH_watchdog.json`, platform `{wd.get('platform')}`):",
+            "",
+            "| Cost | p50 ms | p99 ms |",
+            "|---|---|---|",
+        ]
+        p50, p99 = ev.get("p50"), ev.get("p99")
+        lines.append(
+            "| detector sweep + incident observe | {a} | {b} |".format(
+                a=f"{float(p50):.4f}" if p50 is not None else "n/a",
+                b=f"{float(p99):.4f}" if p99 is not None else "n/a",
+            )
+        )
+        open_ms = wd.get("incident_open_ms")
+        if open_ms is not None:
+            lines.append(
+                f"| incident open e2e (sweep -> ranked record on disk) "
+                f"| {float(open_ms):.4f} | — |"
+            )
+        frac = wd.get("eval_frac_of_iter")
+        iter_ms = wd.get("iter_ms")
+        lines += [
+            "",
+            (
+                f"The sweep p99 costs {float(frac):.3%} of the "
+                f"{float(iter_ms):.0f} ms steady-state iteration "
+                f"(commitment <= "
+                f"{float(wd.get('eval_frac_max', 0.01)):.0%})"
+                if frac is not None and iter_ms is not None
+                else "The overhead fraction was not recorded"
+            )
+            + ". Gated by `perf_gate.gate_watchdog`, folded into "
+            "`gate()`.",
+        ]
+    return lines
+
+
 def _load_tune_bench():
     """Load the autotuner artifact (``BENCH_tune.json``, written by
     ``surreal_tpu tune ... --out BENCH_tune.json``) if present — like
@@ -2108,6 +2196,7 @@ def main(argv=None) -> None:
     lines += _gateway_lines()
     lines += _ops_plane_lines()
     lines += _trace_lines()
+    lines += _watchdog_lines()
     if scaling:
         lines += [
             "",

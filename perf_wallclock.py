@@ -1305,6 +1305,184 @@ def trace_main(argv) -> int:
     return 0
 
 
+# -- watchdog & incident engine (--watchdog) ----------------------------------
+
+WATCHDOG_SWEEPS = 300    # detector-sweep sample size (steady state)
+# the overhead commitment gate_watchdog enforces: one full detector sweep
+# over a production-census snapshot (all breakout/saturation/growth/
+# liveness/regression families armed) plus the incident engine's
+# per-sweep observe() costs <= 1% of one steady-state train iteration —
+# the watchdog judges the workload, it must never become one
+WATCHDOG_EVAL_FRAC_MAX = 0.01
+
+
+def _watchdog_snap(i: int, rows, anomalous: bool = False) -> dict:
+    """One merged-snapshot dict at the ``_ops_rows`` production census
+    (the same tier shapes ``--ops-plane`` prices), with every detector
+    family's signals present; ``anomalous`` flips the fleet tier into
+    the killed-replica shape (DEAD + serve/RTT breakout) so the
+    incident-open path can be timed end-to-end."""
+    tiers = {}
+    for name, row in rows:
+        tiers[name] = {
+            "age_s": 0.2, "dead": False, "cadence_s": 1.0,
+            "gauges": dict(row.get("gauges") or {}),
+            "hops": dict(row.get("hops") or {}),
+            "body": row.get("body"),
+        }
+    tiers["learner"] = {
+        "age_s": 0.0, "dead": False, "cadence_s": 1.0,
+        "gauges": {
+            "time/env_steps_per_s": 5.0e4, "perf/mfu": 0.3,
+            "experience/sample_wait_ms": 1.0,
+            "fleet/serve_ms": 2.0, "fleet/respawns": 0.0,
+            "lineage/staleness_p99": 2.0,
+            "trace/dropped_spans": 0.0, "gateway/bad_frames": 0.0,
+        },
+    }
+    gw_p99 = 9.8
+    if anomalous:
+        rep = tiers.get("fleet.replica0")
+        if rep is not None:
+            rep["age_s"], rep["dead"] = 9.0, True
+        tiers["learner"]["gauges"]["fleet/serve_ms"] = 80.0
+        gw_p99 = 250.0
+    return {
+        "type": "ops_snapshot", "t": 1000.0 + 0.1 * i, "seq": i,
+        "iteration": i, "env_steps": i * 512, "trace": "bench",
+        "tiers": tiers,
+        "hops": {"gateway_act_ms": {"p50": 1.2, "p90": 3.4, "p99": gw_p99}},
+        "slo": {}, "bad_frames": 0,
+    }
+
+
+def _watchdog_measure() -> dict:
+    """The watchdog campaign (standalone — no training run): full
+    detector sweep + incident-engine observe per snapshot at the
+    production tier census, plus the incident-open end-to-end latency
+    (anomalous snapshot in -> incident-1.json on disk), against the
+    steady-state iteration time."""
+    import tempfile
+
+    import numpy as np
+
+    from surreal_tpu.session.incidents import IncidentEngine
+    from surreal_tpu.session.watchdog import Watchdog
+
+    def pctl(samples_ms):
+        arr = np.asarray(samples_ms)
+        return {
+            "p50": round(float(np.percentile(arr, 50)), 5),
+            "p99": round(float(np.percentile(arr, 99)), 5),
+        }
+
+    rows = _ops_rows()
+    eval_ms = []
+    with tempfile.TemporaryDirectory() as folder:
+        wd = Watchdog(
+            # a synthetic baseline row arms the regression detector so
+            # the priced sweep includes every family
+            baseline_rows=[{
+                "file": "BENCH_bench.json", "round": 0,
+                "metric": "env_steps_per_sec_bench", "value": 9.0e4,
+                "platform": None, "geometry": None, "mfu": 0.5,
+                "arm": None, "failed": False,
+            }],
+        )
+        eng = IncidentEngine(folder=folder, trace_id="bench")
+        for i in range(WATCHDOG_SWEEPS):
+            snap = _watchdog_snap(i, rows)
+            t0 = time.perf_counter()
+            firings = wd.evaluate(snap)
+            eng.observe(firings, snap)
+            eval_ms.append((time.perf_counter() - t0) * 1e3)
+        # incident-open e2e: anomalous snapshot in -> record on disk.
+        # Liveness fires on the FIRST anomalous sweep, so one sweep is
+        # the whole open path (absorb + rank + atomic write included).
+        i0 = WATCHDOG_SWEEPS
+        t0 = time.perf_counter()
+        snap = _watchdog_snap(i0, rows, anomalous=True)
+        eng.observe(wd.evaluate(snap), snap)
+        open_ms = (time.perf_counter() - t0) * 1e3
+        import os as _os
+
+        from surreal_tpu.session.incidents import INCIDENTS_DIR
+        from surreal_tpu.session.telemetry import TELEMETRY_DIR
+
+        rec = _os.path.join(
+            folder, TELEMETRY_DIR, INCIDENTS_DIR, "incident-1.json"
+        )
+        if not _os.path.isfile(rec):
+            raise RuntimeError(
+                "anomalous snapshot did not open a persisted incident"
+            )
+    iter_ms = _ops_iter_ms()
+    ev = pctl(eval_ms)
+    return {
+        "eval_ms": ev,
+        "incident_open_ms": round(open_ms, 4),
+        "iter_ms": round(iter_ms, 3),
+        "eval_frac_of_iter": round(ev["p99"] / iter_ms, 5),
+        "sweeps": WATCHDOG_SWEEPS,
+        "workload": (
+            f"{len(rows)} wire tiers + learner row, all 5 detector "
+            "families armed (regression vs synthetic baseline); "
+            "iter: PPO jax:cartpole 512x64 (1 epoch)"
+        ),
+    }
+
+
+def watchdog_main(argv) -> int:
+    """--watchdog driver (ISSUE 15): per-cadence cost of the watchdog
+    detector sweep + incident engine, and the incident-open end-to-end
+    latency. Writes ``BENCH_watchdog.json`` (perf_gate.gate_watchdog and
+    PERF.md's generated section consume it), with bench.py's bounded
+    retry/backoff and structured failed-round artifact."""
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_watchdog.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            row = _watchdog_measure()
+            result = {
+                "metric": "watchdog_eval_frac_of_iter",
+                "value": row["eval_frac_of_iter"],
+                "unit": "frac",
+                "geometry": row["workload"],
+                "eval_frac_max": WATCHDOG_EVAL_FRAC_MAX,
+                **row,
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"watchdog attempt {attempt + 1}/{RETRY_ATTEMPTS} "
+                    f"failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -1322,6 +1500,8 @@ def main(argv=None) -> None:
         sys.exit(ops_plane_main(argv))
     if "--trace" in argv:
         sys.exit(trace_main(argv))
+    if "--watchdog" in argv:
+        sys.exit(watchdog_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

@@ -263,6 +263,39 @@ class SessionHooks:
         # trigger-file captures, and the slow-iteration auto-trigger all
         # live behind one boundary tick
         self.profile = ProfileManager(cfg, cfg.folder, self.tracer, self.log)
+        # watchdog & incident engine (ISSUE 15): detector sweeps over each
+        # merged ops snapshot, firings correlated into root-caused
+        # incident records under telemetry/incidents/ (`surreal_tpu why`).
+        # Both are pure host arithmetic at the metrics cadence.
+        wd_cfg = cfg.get("watchdog", None)
+        self.watchdog = None
+        self.incidents = None
+        if wd_cfg is None or wd_cfg.get("enabled", True):
+            import jax
+
+            from surreal_tpu.session.incidents import IncidentEngine
+            from surreal_tpu.session.watchdog import Watchdog
+
+            base_dir = (
+                wd_cfg.get("baseline_dir", None) if wd_cfg is not None else None
+            )
+            self.watchdog = Watchdog(
+                cfg=wd_cfg,
+                baseline_rows=Watchdog.load_baseline(base_dir)
+                if base_dir
+                else None,
+                platform=jax.default_backend(),
+                geometry=f"{jax.device_count()}x{type(jax.devices()[0]).__name__}",
+            )
+            self.incidents = IncidentEngine(
+                folder=cfg.folder,
+                cfg=wd_cfg,
+                on_event=self.tracer.event,
+                profile=self.profile,
+                flightrec=self.ops.flightrec,
+                exemplar_source=self.tracer.recent_exemplar_spans,
+                trace_id=self.trace_id,
+            )
         self._last_eval: dict[str, float] = {}
         self._last_train: dict[str, float] = {}
         self._metrics_every = PeriodicTracker(max(1, cfg.metrics.every_n_iters))
@@ -512,6 +545,12 @@ class SessionHooks:
                     "iteration": int(iteration), "env_steps": int(env_steps),
                 })
                 self.ops.dump("recovery")
+                if self.incidents is not None:
+                    self.incidents.record_recovery({
+                        "reason": str(trip_reason),
+                        "iteration": int(iteration),
+                        "env_steps": int(env_steps),
+                    })
         # skip the state-consuming side-bands while the guard is tripped in
         # BOTH rollback and warn modes (warn is the multi-host setting — a
         # poisoned save would make auto_resume restore the poison).
@@ -586,8 +625,17 @@ class SessionHooks:
                     if isinstance(v, (int, float))
                 },
             )
-            self.ops.snapshot(int(iteration), int(env_steps))
+            snap = self.ops.snapshot(int(iteration), int(env_steps))
             m.update(self.ops.gauges())
+            # watchdog sweep over the snapshot just merged + incident
+            # lifecycle — both pure host arithmetic on the snapshot dict
+            # (no device state in reach), so the same transfer-guard test
+            # covers them
+            if self.watchdog is not None and snap is not None:
+                firings = self.watchdog.evaluate(snap)
+                self.incidents.observe(firings, snap)
+                m.update(self.watchdog.gauges())
+                m.update(self.incidents.gauges())
             self._last_train = m
         if m or evaled:
             self.writer.write(env_steps, {**(m or {}), **evaled})
@@ -619,6 +667,8 @@ class SessionHooks:
         for ev in fired:
             self.tracer.event("fault", **ev)
             self.ops.record_fault(ev)
+            if self.incidents is not None:
+                self.incidents.record_fault(ev)
         if fired:
             self.ops.dump("fault")
         stop = m is not None and on_metrics is not None and bool(
@@ -695,6 +745,13 @@ class SessionHooks:
         for ev in faults.drain_fired():  # tail faults since the last boundary
             self.tracer.event("fault", **ev)
             self.ops.record_fault(ev)
+            if self.incidents is not None:
+                self.incidents.record_fault(ev)
+        # flush a still-open incident to disk (closed_t stays None — the
+        # record shows the run ended mid-incident) before the planes it
+        # reads from come down
+        if self.incidents is not None:
+            self.incidents.close()
         # stop the ops receiver BEFORE the tiers that push into it come
         # down (a pushed row into a closed PULL is just dropped, but the
         # join here keeps thread teardown deterministic)

@@ -886,6 +886,28 @@ def run_trace(args) -> int:
     return 0
 
 
+def run_why(args) -> int:
+    """Root-caused incident reports from the watchdog/incident engine
+    (session/incidents.py): what fired, the ranked cause hypotheses with
+    their correlated evidence (faults, respawns, SLO breaches, slowest
+    exemplar spans), and where the auto-captured profile/flight-recorder
+    artifacts landed. Pure file reading over telemetry/incidents/ — no
+    jax, no zmq — so it works off-chip and against a live run, like
+    ``diag``/``top``/``trace``."""
+    from surreal_tpu.session.incidents import incidents_report
+
+    if not os.path.isdir(args.folder):
+        print(f"no session folder {args.folder!r}", file=sys.stderr)
+        return 2
+    report = incidents_report(args.folder, incident=args.incident)
+    if report is None:
+        print(f"no telemetry under {args.folder!r} (is this a "
+              "session folder?)", file=sys.stderr)
+        return 2
+    print(report)
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="surreal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -1041,6 +1063,16 @@ def main(argv=None) -> int:
     tr.add_argument("--limit", type=int, default=16,
                     help="newest exemplars to render (default 16)")
     tr.set_defaults(fn=run_trace)
+
+    w = sub.add_parser("why", help="root-caused incident reports from "
+                       "the watchdog (what fired, ranked cause "
+                       "hypotheses, correlated faults/SLO breaches/"
+                       "exemplars, auto-captured artifacts)")
+    w.add_argument("folder", help="session folder (holds telemetry/)")
+    w.add_argument("--incident", type=int, default=None,
+                   help="render one incident in full detail (default: "
+                   "all, newest last)")
+    w.set_defaults(fn=run_why)
 
     args = parser.parse_args(argv)
     # the --local-procs supervisor re-issues this exact command per rank

@@ -37,209 +37,227 @@ from __future__ import annotations
 
 from typing import Any
 
+# the units a registered gauge may declare. The watchdog's threshold
+# arithmetic keys off these (counters vs latencies vs ratios), and
+# `surreal_tpu why` renders firing values with them — so the unit lint
+# in tests/test_import_hygiene.py rejects anything outside this set.
+GAUGE_UNITS = frozenset(
+    {"ms", "bytes", "count", "ratio", "steps/s", "flops/s", "scalar"}
+)
+
+
+def _g(unit: str, desc: str) -> dict[str, str]:
+    """One GAUGE_REGISTRY record: a documented description plus the
+    machine-readable unit (ISSUE 15 — units used to live only in
+    prose)."""
+    return {"unit": unit, "desc": desc}
+
+
+def gauge_unit(name: str) -> str | None:
+    """The declared unit of a registered gauge, None for unregistered
+    names (per-instance body keys the tiers invent)."""
+    rec = GAUGE_REGISTRY.get(name)
+    return rec.get("unit") if isinstance(rec, dict) else None
+
+
 # Documented registry of every perf/*, replay/*, experience/*, fleet/*,
 # param/*, and gateway/* gauge the codebase may emit.
 # tests/test_import_hygiene.py::test_perf_gauges_appear_in_registry scans
 # the package source for whole "<prefix>/<name>" literals and fails on
-# any not listed here. Keep descriptions current — diag and README point
-# here. Per-shard detail for the experience plane rides the
+# any not listed here; every record carries a {unit, desc} dict (the unit
+# lint rejects a bare string). Keep descriptions current — diag and
+# README point here. Per-shard detail for the experience plane rides the
 # 'experience_plane' telemetry EVENT (diag's "Experience plane" section);
 # the metrics-row gauges below are the fleet aggregates.
 GAUGE_REGISTRY = {
-    "perf/mfu": (
-        "model FLOP utilization over the metrics window: sum over "
-        "registered programs of (flops/call x calls) / (phase seconds x "
-        "peak FLOP/s). Lower bound when a phase contains non-program work."
-    ),
-    "perf/membw_util": (
-        "memory-bandwidth utilization over the metrics window: bytes "
-        "accessed (XLA cost model) per second / peak bytes/s."
-    ),
-    "perf/flops_per_s": (
-        "achieved model FLOP/s over the metrics window (the MFU numerator; "
-        "emitted even when no peak spec is known for the device)."
-    ),
+    "perf/mfu": _g("ratio",
+        'model FLOP utilization over the metrics window: sum over '
+        'registered programs of (flops/call x calls) / (phase seconds x '
+        'peak FLOP/s). Lower bound when a phase contains non-program work.'),
+    "perf/membw_util": _g("ratio",
+        'memory-bandwidth utilization over the metrics window: bytes '
+        'accessed (XLA cost model) per second / peak bytes/s.'),
+    "perf/flops_per_s": _g("flops/s",
+        'achieved model FLOP/s over the metrics window (the MFU numerator; '
+        'emitted even when no peak spec is known for the device).'),
     # -- replay occupancy (replay/base.py ring gauges; device scalars) ------
-    "replay/size": "absolute ring fill (transitions currently held).",
-    "replay/fill": "ring fill as a fraction of capacity.",
-    "replay/max_priority": (
+    "replay/size": _g("count",
+        'absolute ring fill (transitions currently held).'),
+    "replay/fill": _g("ratio", 'ring fill as a fraction of capacity.'),
+    "replay/max_priority": _g("scalar",
         "prioritized replay's fresh-insert priority scale (pmax-synced "
-        "across dp shards)."
-    ),
-    "replay/sample_age_frac": (
-        "mean staleness of a sampled index batch as a fraction of the "
-        "current fill (0 = just written)."
-    ),
+        'across dp shards).'),
+    "replay/sample_age_frac": _g("ratio",
+        'mean staleness of a sampled index batch as a fraction of the '
+        'current fill (0 = just written).'),
     # -- experience plane (surreal_tpu/experience/; fleet aggregates) -------
-    "experience/shards_live": "replay shard servers currently alive.",
-    "experience/respawns": (
-        "shard respawns performed by the plane supervisor this run."
-    ),
-    "experience/rows": "total transitions ingested across all shards.",
-    "experience/fill": "mean shard ring fill fraction.",
-    "experience/ingest_rows_per_s": (
-        "summed shard ingestion rate (the actor-fleet throughput the "
-        "plane absorbs)."
-    ),
-    "experience/wire_bytes_per_step": (
-        "shard-side wire bytes (in+out) per ingested transition — the "
-        "zero-copy success metric (control frames vs shipped arrays)."
-    ),
-    "experience/sample_queue_depth": (
-        "sample requests deferred at shards (watermark not yet ingested)."
-    ),
-    "experience/sample_wait_ms": (
-        "EWMA of the learner's wait for a prefetched iteration of "
-        "batches — ~0 means the learner never waits on experience ingest."
-    ),
-    "experience/dropped_rows": (
+    "experience/shards_live": _g("count",
+        'replay shard servers currently alive.'),
+    "experience/respawns": _g("count",
+        'shard respawns performed by the plane supervisor this run.'),
+    "experience/rows": _g("count",
+        'total transitions ingested across all shards.'),
+    "experience/fill": _g("ratio", 'mean shard ring fill fraction.'),
+    "experience/ingest_rows_per_s": _g("steps/s",
+        'summed shard ingestion rate (the actor-fleet throughput the plane '
+        'absorbs).'),
+    "experience/wire_bytes_per_step": _g("bytes",
+        'shard-side wire bytes (in+out) per ingested transition — the '
+        'zero-copy success metric (control frames vs shipped arrays).'),
+    "experience/sample_queue_depth": _g("count",
+        'sample requests deferred at shards (watermark not yet ingested).'),
+    "experience/sample_wait_ms": _g("ms",
+        "EWMA of the learner's wait for a prefetched iteration of batches — "
+        '~0 means the learner never waits on experience ingest.'),
+    "experience/dropped_rows": _g("count",
         "transitions dropped after the sender's bounded retry budget "
-        "exhausted against a dead shard."
-    ),
+        'exhausted against a dead shard.'),
     # -- serving tier (distributed/fleet.py; fleet aggregates) --------------
-    "fleet/replicas_live": "inference-server replicas currently alive.",
-    "fleet/respawns": (
-        "replica respawns performed by the fleet supervisor this run "
-        "(in place, fixed address, exponential backoff)."
-    ),
-    "fleet/scale_ups": "autoscale replica additions this run.",
-    "fleet/scale_downs": "autoscale replica drains this run.",
-    "fleet/serve_ms": (
-        "fleet-mean serve-latency EWMA — the autoscaler's up/down signal."
-    ),
-    "fleet/queue_depth": "summed trajectory-chunk queue depth across replicas.",
+    "fleet/replicas_live": _g("count",
+        'inference-server replicas currently alive.'),
+    "fleet/respawns": _g("count",
+        'replica respawns performed by the fleet supervisor this run (in '
+        'place, fixed address, exponential backoff).'),
+    "fleet/scale_ups": _g("count", 'autoscale replica additions this run.'),
+    "fleet/scale_downs": _g("count", 'autoscale replica drains this run.'),
+    "fleet/serve_ms": _g("ms",
+        "fleet-mean serve-latency EWMA — the autoscaler's up/down signal."),
+    "fleet/queue_depth": _g("count",
+        'summed trajectory-chunk queue depth across replicas.'),
     # -- parameter fanout (distributed/param_fanout.py) ---------------------
-    "param/publishes": "weight frames broadcast by the fanout this run.",
-    "param/full_frames": "full (key) frames among them.",
-    "param/delta_frames": "delta frames among them.",
-    "param/rekeys": (
-        "full frames FORCED by a stale/absent subscriber ack (a dropped "
-        "frame or late joiner re-keys the delta stream)."
-    ),
-    "param/bytes_last_publish": "wire bytes of the newest frame.",
-    "param/bytes_published": "cumulative fanout wire bytes this run.",
-    "param/subscribers": "subscribers with a fresh (ttl-bounded) ack.",
+    "param/publishes": _g("count",
+        'weight frames broadcast by the fanout this run.'),
+    "param/full_frames": _g("count", 'full (key) frames among them.'),
+    "param/delta_frames": _g("count", 'delta frames among them.'),
+    "param/rekeys": _g("count",
+        'full frames FORCED by a stale/absent subscriber ack (a dropped '
+        'frame or late joiner re-keys the delta stream).'),
+    "param/bytes_last_publish": _g("bytes", 'wire bytes of the newest frame.'),
+    "param/bytes_published": _g("bytes",
+        'cumulative fanout wire bytes this run.'),
+    "param/subscribers": _g("count",
+        'subscribers with a fresh (ttl-bounded) ack.'),
     # subscriber-side counters (ParameterSubscriber.gauges — actor/eval
     # processes and tests; not part of the trainer's metrics rows)
-    "param/applied_frames": "frames this subscriber applied.",
-    "param/stale_frames": (
-        "inapplicable deltas this subscriber dropped (missed frame / "
-        "fresh join) — each flags needs_resync toward the fetch fallback."
-    ),
-    "param/fallback_fetches": (
-        "ParameterClient.fetch catch-ups this subscriber performed "
-        "(the late-joiner / dropped-frame path; counted, never silent)."
-    ),
-    "param/holds": (
-        "param versions the fanout currently holds pinned for gateway "
-        "sessions (full frames retained until every pin releases)."
-    ),
+    "param/applied_frames": _g("count", 'frames this subscriber applied.'),
+    "param/stale_frames": _g("count",
+        'inapplicable deltas this subscriber dropped (missed frame / fresh '
+        'join) — each flags needs_resync toward the fetch fallback.'),
+    "param/fallback_fetches": _g("count",
+        'ParameterClient.fetch catch-ups this subscriber performed (the '
+        'late-joiner / dropped-frame path; counted, never silent).'),
+    "param/holds": _g("count",
+        'param versions the fanout currently holds pinned for gateway '
+        'sessions (full frames retained until every pin releases).'),
     # -- session gateway (surreal_tpu/gateway/; tenant-facing tier) ---------
-    "gateway/sessions": "sessions currently attached across all tenants.",
-    "gateway/attaches": "sessions admitted this run (first attach only).",
-    "gateway/reattaches": (
-        "re-attaches onto a live session id (client reconnect; the "
-        "session record and its replica binding survive)."
-    ),
-    "gateway/detaches": "explicit tenant detaches this run.",
-    "gateway/acts": "act requests served (cache hits included).",
-    "gateway/cache_hits": (
-        "acts answered from the bounded (version, obs-digest) act cache "
-        "without touching a fleet replica."
-    ),
-    "gateway/cache_misses": "acts that paid a fleet serve_act forward.",
-    "gateway/migrations": (
-        "session rebinds performed after a replica death (invisible "
-        "failover; counted per moved session)."
-    ),
-    "gateway/catch_ups": (
-        "pinned sessions force-unpinned because their param version was "
+    "gateway/sessions": _g("count",
+        'sessions currently attached across all tenants.'),
+    "gateway/attaches": _g("count",
+        'sessions admitted this run (first attach only).'),
+    "gateway/reattaches": _g("count",
+        're-attaches onto a live session id (client reconnect; the session '
+        'record and its replica binding survive).'),
+    "gateway/detaches": _g("count", 'explicit tenant detaches this run.'),
+    "gateway/acts": _g("count", 'act requests served (cache hits included).'),
+    "gateway/cache_hits": _g("count",
+        'acts answered from the bounded (version, obs-digest) act cache '
+        'without touching a fleet replica.'),
+    "gateway/cache_misses": _g("count",
+        'acts that paid a fleet serve_act forward.'),
+    "gateway/migrations": _g("count",
+        'session rebinds performed after a replica death (invisible '
+        'failover; counted per moved session).'),
+    "gateway/catch_ups": _g("count",
+        'pinned sessions force-unpinned because their param version was '
         "evicted from the fleet's act history (flagged on the reply — "
-        "counted, never silent)."
-    ),
-    "gateway/pinned_sessions": "sessions currently pinned to a param version.",
-    "gateway/dropped_replies": (
-        "act replies swallowed by fault injection (gateway.session "
-        "drop_frame); the client's bounded resend redelivers."
-    ),
-    "gateway/bad_frames": (
-        "malformed/hostile tenant frames dropped at the serve loop's "
-        "frame boundary (truncated headers, bad obs bodies, undecodable "
-        "or un-negotiated pickle fallbacks) — counted, never a crash."
-    ),
-    "gateway/respawns": (
-        "gateway serve-thread respawns performed by its supervisor "
-        "(in place, fixed address, shared backoff schedule)."
-    ),
+        'counted, never silent).'),
+    "gateway/pinned_sessions": _g("count",
+        'sessions currently pinned to a param version.'),
+    "gateway/dropped_replies": _g("count",
+        'act replies swallowed by fault injection (gateway.session '
+        "drop_frame); the client's bounded resend redelivers."),
+    "gateway/bad_frames": _g("count",
+        "malformed/hostile tenant frames dropped at the serve loop's frame "
+        'boundary (truncated headers, bad obs bodies, undecodable or '
+        'un-negotiated pickle fallbacks) — counted, never a crash.'),
+    "gateway/respawns": _g("count",
+        'gateway serve-thread respawns performed by its supervisor (in '
+        'place, fixed address, shared backoff schedule).'),
     # admission plane (gateway/admission.py)
-    "gateway/rejected_sessions": (
-        "attach attempts refused — by session quota (global or "
-        "per-tenant) or by the re-attach tenant/token credential check."
-    ),
-    "gateway/throttled_acts": (
+    "gateway/rejected_sessions": _g("count",
+        'attach attempts refused — by session quota (global or per-tenant) '
+        'or by the re-attach tenant/token credential check.'),
+    "gateway/throttled_acts": _g("count",
         "acts past a tenant's token-bucket rate, parked in its bounded "
-        "queue instead of served immediately."
-    ),
-    "gateway/evicted_requests": (
+        'queue instead of served immediately.'),
+    "gateway/evicted_requests": _g("count",
         "oldest queued acts evicted when a tenant's backpressure queue "
-        "overflowed (each gets an ACT_ERR — counted, never silent)."
-    ),
-    "gateway/expired_leases": "sessions reaped idle past their lease.",
-    "gateway/queued_acts": "acts currently parked across tenant queues.",
+        'overflowed (each gets an ACT_ERR — counted, never silent).'),
+    "gateway/expired_leases": _g("count",
+        'sessions reaped idle past their lease.'),
+    "gateway/queued_acts": _g("count",
+        'acts currently parked across tenant queues.'),
     # -- live ops plane (session/opsplane.py; ISSUE 13) ---------------------
-    "ops/tiers": (
-        "tiers that have pushed at least one row to the run aggregator "
-        "(gateway, fleet replicas, experience shards, learner, fanout)."
-    ),
-    "ops/bad_frames": (
-        "undecodable/hostile rows dropped at the aggregator's PULL "
-        "boundary — counted, never a crash."
-    ),
-    "ops/snapshots": (
-        "merged run snapshots written to telemetry/ops_snapshot.json "
-        "(one per metrics cadence; the file `surreal_tpu top` renders)."
-    ),
-    "ops/flightrec_dumps": (
-        "flight-recorder dumps written under telemetry/flightrec/ "
-        "(recovery trip, chaos fault, or SLO budget exhaustion; at most "
-        "one per trigger per cooldown)."
-    ),
+    "ops/tiers": _g("count",
+        'tiers that have pushed at least one row to the run aggregator '
+        '(gateway, fleet replicas, experience shards, learner, fanout).'),
+    "ops/bad_frames": _g("count",
+        "undecodable/hostile rows dropped at the aggregator's PULL boundary "
+        '— counted, never a crash.'),
+    "ops/snapshots": _g("count",
+        'merged run snapshots written to telemetry/ops_snapshot.json (one '
+        'per metrics cadence; the file `surreal_tpu top` renders).'),
+    "ops/flightrec_dumps": _g("count",
+        'flight-recorder dumps written under telemetry/flightrec/ (recovery '
+        'trip, chaos fault, SLO budget exhaustion, or an opened incident; '
+        'at most one per trigger per cooldown).'),
+    # watchdog & incident engine (session/watchdog.py, session/incidents.py)
+    "ops/watchdog_evals": _g("count",
+        'detector sweeps run over merged ops snapshots (one per metrics '
+        'cadence while session_config.watchdog.enabled).'),
+    "ops/watchdog_dropped_evals": _g("count",
+        'detector sweeps skipped by the watchdog.eval chaos site '
+        '(drop_eval) — counted, never silent.'),
+    "ops/watchdog_firings": _g("count",
+        'detector firings across all sweeps this run (breakout, '
+        'saturation, growth, liveness, regression).'),
+    "ops/incidents_open": _g("count",
+        'whether an incident is currently open (0/1 — the engine holds at '
+        'most one open incident, extending it while detectors keep '
+        'firing).'),
+    "ops/incidents_total": _g("count",
+        'incidents opened this run (each persisted under '
+        'telemetry/incidents/incident-<n>.json and rendered by '
+        '`surreal_tpu why`).'),
     # per-tenant SLOs (session/slo.py)
-    "slo/breaches": (
-        "SLO evaluation windows that breached a declared objective "
-        "(every one is also a counted slo_breach telemetry event)."
-    ),
-    "slo/exhaustions": (
-        "error budgets exhausted this run (edge-triggered: one per "
-        "incident, each freezing a flightrec/slo dump)."
-    ),
-    "slo/objectives": "objectives armed via session_config.slo.* targets.",
-    "lineage/staleness_p50": (
-        "exact per-update staleness median: p50 over (current version - "
-        "acting version) of every transition in the batch that entered "
-        "this gradient, from the collection-time lineage stamps. Host "
-        "numpy over the already-fetched version column — no device sync."
-    ),
-    "lineage/staleness_p99": (
+    "slo/breaches": _g("count",
+        'SLO evaluation windows that breached a declared objective (every '
+        'one is also a counted slo_breach telemetry event).'),
+    "slo/exhaustions": _g("count",
+        'error budgets exhausted this run (edge-triggered: one per '
+        'incident, each freezing a flightrec/slo dump).'),
+    "slo/objectives": _g("count",
+        'objectives armed via session_config.slo.* targets.'),
+    "lineage/staleness_p50": _g("count",
+        'exact per-update staleness median: p50 over (current version - '
+        'acting version) of every transition in the batch that entered this '
+        'gradient, from the collection-time lineage stamps. Host numpy over '
+        'the already-fetched version column — no device sync.'),
+    "lineage/staleness_p99": _g("count",
         "exact per-update staleness p99 over the batch's acting-policy "
         "versions (the SLO plane's staleness objective prefers this over "
-        "the published-vs-held approximation when lineage is on)."
-    ),
-    "lineage/staleness_max": (
-        "oldest transition that entered this update, in version lags."
-    ),
-    "lineage/versions_per_batch": (
-        "distinct acting-policy versions mixed into this update's batch "
-        "(1 == perfectly on-policy data)."
-    ),
-    "trace/spans": (
-        "causal spans emitted so far by this process's tracer "
-        "(head-sampled exemplars, telemetry.trace.sample_n)."
-    ),
-    "trace/dropped_spans": (
-        "spans dropped by the trace.emit chaos site — counted, never "
-        "silent; the exemplar's tree renders with the torn hop marked."
-    ),
+        'the published-vs-held approximation when lineage is on).'),
+    "lineage/staleness_max": _g("count",
+        'oldest transition that entered this update, in version lags.'),
+    "lineage/versions_per_batch": _g("count",
+        "distinct acting-policy versions mixed into this update's batch (1 "
+        '== perfectly on-policy data).'),
+    "trace/spans": _g("count",
+        "causal spans emitted so far by this process's tracer (head-sampled "
+        'exemplars, telemetry.trace.sample_n).'),
+    "trace/dropped_spans": _g("count",
+        'spans dropped by the trace.emit chaos site — counted, never '
+        "silent; the exemplar's tree renders with the torn hop marked."),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

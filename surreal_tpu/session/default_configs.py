@@ -427,6 +427,40 @@ BASE_SESSION_CONFIG = Config(
         throttle_rate=None,       # throttled / (throttled + served) per window
         staleness_updates=None,   # published version - oldest replica version
     ),
+    # watchdog & incident engine (ISSUE 15, session/watchdog.py +
+    # session/incidents.py): detector sweeps over the merged ops snapshot
+    # at the metrics cadence — EWMA/MAD breakouts on the headline
+    # latencies/throughputs, queue/backpressure saturation, monotonic
+    # growth of every dropped/bad_frames counter, tier liveness from the
+    # ops plane's DEAD rendering, and online regression vs a committed
+    # BENCH baseline. Firings open root-caused incidents (one open at a
+    # time) persisted under telemetry/incidents/ and rendered by
+    # `surreal_tpu why <folder>`. Pure host arithmetic over the snapshot
+    # dict — no device->host syncs (transfer-guard tested), overhead
+    # committed <=1% of iteration time (perf_gate.gate_watchdog).
+    watchdog=Config(
+        enabled=True,
+        warmup=8,            # sweeps before breakout detectors arm
+        window=32,           # rolling median/MAD window (sweeps)
+        mad_k=6.0,           # breakout: |x - median| > mad_k * MAD floor
+        min_rel=0.25,        # ... AND relative deviation above this
+        sustain=2,           # consecutive outlier sweeps before firing
+        queue_depth_max=512.0,   # saturation threshold for queue gauges
+        respawn_burst=2,     # respawn deltas per window that count as a burst
+        growth_windows=2,    # consecutive growing windows for drop counters
+        staleness_growth_windows=4,  # ... for lineage/staleness_p99
+        staleness_floor=64.0,  # versions; the startup ramp toward
+        # steady-state pipeline depth stays below this and never fires
+        regression_frac=0.5,     # fire when live throughput/MFU < frac*bench
+        regression_sustain=3,
+        baseline_dir=None,   # dir of BENCH_r*.json rows (None -> repo root)
+        # incident engine knobs (session/incidents.py)
+        close_windows=5,         # clean sweeps before incident_close
+        evidence_window_s=120.0,  # fault/recovery correlation horizon
+        update_every=5,          # firing windows between incident_update
+        max_captures=4,          # auto profile+flightrec captures per run
+        capture_cooldown_s=60.0,
+    ),
     eval=Config(
         every_n_iters=100,
         episodes=5,

@@ -527,6 +527,20 @@ def top_report(snap: dict | None, folder: str | None = None) -> str:
     })
     if perf_lines:
         lines += ["", "Performance"] + perf_lines
+    # watchdog incidents (ISSUE 15): same brief as diag's Incidents
+    # section — pure file reading under <folder>/telemetry/incidents/,
+    # so a live `top` shows an opened incident within one refresh
+    if folder:
+        try:
+            from surreal_tpu.session.incidents import incidents_brief
+
+            inc_lines = incidents_brief(folder)
+        except Exception:
+            inc_lines = []
+        if inc_lines:
+            lines += [
+                "", "Incidents (surreal_tpu why for the full report)",
+            ] + inc_lines
     return "\n".join(lines)
 
 

@@ -87,6 +87,9 @@ class ProfileManager:
         self._last_stat = 0.0
         self._pending: tuple[str, int] | None = None  # (reason, num_iters)
         self._active: dict | None = None
+        # newest completed capture directory — the incident engine links
+        # the capture it auto-requested into the incident record from here
+        self.last_capture_dir: str | None = None
         self._last_tick: float | None = None
         self._last_iter = 0  # newest iteration ticked (close() reports it)
         self._ewma_s: float | None = None
@@ -131,11 +134,22 @@ class ProfileManager:
         except Exception as e:
             self._log.warning("profiler stop failed: %s", e)
         if act is not None:
+            self.last_capture_dir = act["dir"]
             self._tracer.event(
                 "profile", dir=act["dir"], reason=act["reason"],
                 start_iter=act["start_iter"], end_iter=int(iteration),
             )
             self._log.info("profiler capture saved -> %s", act["dir"])
+
+    def request(self, reason: str, num_iters: int | None = None) -> bool:
+        """Queue a capture window starting at the next boundary tick —
+        the incident engine's auto-capture path (programmatic spelling of
+        the trigger file). Refused (False) while a capture is active or
+        already queued, so one incident cannot stack windows."""
+        if self._active is not None or self._pending is not None:
+            return False
+        self._pending = (str(reason), max(1, int(num_iters or self._num_iters)))
+        return True
 
     # -- per-iteration tick --------------------------------------------------
     def tick(self, iteration: int) -> None:

@@ -196,6 +196,12 @@ EVENT_REGISTRY = {
     "ops_snapshot": "ops-plane merged-snapshot pointer (session/opsplane.py)",
     "slo_breach": "per-tenant SLO window breach (session/slo.py)",
     "ops_flightrec": "flight-recorder dump record (session/opsplane.py)",
+    "incident_open": "watchdog firings opened an incident "
+                     "(session/incidents.py)",
+    "incident_update": "open incident absorbed further firings "
+                       "(session/incidents.py, rate-bounded)",
+    "incident_close": "incident closed on sustained-healthy windows "
+                      "(session/incidents.py)",
 }
 
 
@@ -1012,6 +1018,19 @@ def diag_report(folder: str) -> str | None:
             )
     else:
         lines.append("  (none recorded — single-host session)")
+    # watchdog incidents (ISSUE 15): the `surreal_tpu why` brief, one
+    # line per incident — the full root-cause report is `why`'s job.
+    # Local import: incidents.py pulls in costs.py, and diag must stay a
+    # pure-file-reading path that works even if that import breaks.
+    try:
+        from surreal_tpu.session.incidents import incidents_brief
+
+        inc_lines = incidents_brief(s["folder"])
+    except Exception:
+        inc_lines = []
+    if inc_lines:
+        lines += ["", "Incidents (surreal_tpu why for the full report)"]
+        lines += inc_lines
     return "\n".join(lines)
 
 

@@ -88,6 +88,15 @@ Sites and the kinds they honor:
                          ``delay``: sleep ``ms`` before the emit — spans
                          are side-band, so a slow emit must never shift a
                          hop's measured duration)
+    watchdog.eval        every watchdog detector sweep (session/
+                         watchdog.py, one per ops-snapshot cadence)
+                         (``drop_eval``: skip the sweep — counted in
+                         ``ops/watchdog_dropped_evals``, never silent, so
+                         a run can prove incident detection survives
+                         missing sweeps; ``delay``: sleep ``ms`` before
+                         the sweep — evaluation is host-side and off the
+                         jitted step, so a slow sweep must never shift
+                         measured iteration time)
     gateway.session      once per gateway serve-loop pass
                          (``drop_frame``: swallow the act reply frame —
                          the client's bounded resend redelivers against
@@ -139,6 +148,7 @@ SITES = frozenset(
         "gateway.session",
         "ops.push",
         "trace.emit",
+        "watchdog.eval",
     }
 )
 

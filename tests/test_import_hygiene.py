@@ -409,6 +409,33 @@ def test_perf_gauges_appear_in_registry():
         ), name
 
 
+def test_gauge_registry_entries_declare_units():
+    """Gauge-unit lint (ISSUE 15 satellite): every GAUGE_REGISTRY record
+    must be a ``{unit, desc}`` dict with a unit from the documented set
+    (``session/costs.py::GAUGE_UNITS``) and a nonempty description. The
+    watchdog's threshold arithmetic keys off the unit (counters grow
+    monotonically, latencies break out, ratios saturate) and
+    ``surreal_tpu why`` renders firing values with it — a unitless gauge
+    would make both guess."""
+    from surreal_tpu.session.costs import GAUGE_REGISTRY, GAUGE_UNITS
+
+    assert GAUGE_UNITS, "GAUGE_UNITS emptied; update this lint"
+    bad = []
+    for name, rec in GAUGE_REGISTRY.items():
+        if not isinstance(rec, dict):
+            bad.append(f"{name}: not a {{unit, desc}} record ({type(rec).__name__})")
+            continue
+        if rec.get("unit") not in GAUGE_UNITS:
+            bad.append(f"{name}: unit {rec.get('unit')!r} not in GAUGE_UNITS")
+        if not (isinstance(rec.get("desc"), str) and rec["desc"].strip()):
+            bad.append(f"{name}: empty description")
+    assert not bad, (
+        "GAUGE_REGISTRY entries without a declared unit (wrap the entry "
+        "as _g('<unit>', '<desc>') with a unit from GAUGE_UNITS):\n"
+        + "\n".join(bad)
+    )
+
+
 def test_telemetry_events_appear_in_registry():
     """Event-registry lint (ISSUE 13 satellite, the gauge-lint pattern
     applied to the telemetry spine): every event kind emitted anywhere in
