@@ -317,6 +317,14 @@ def main() -> int:
         from perf_wallclock import watchdog_main
 
         return watchdog_main(sys.argv[1:])
+    if "--control" in sys.argv:
+        # closed-loop control campaign (ISSUE 16): remediation decision
+        # sweep cost, incident -> journaled-action latency, loadgen
+        # sustained rate — writes BENCH_control.json (perf_gate's
+        # control gate consumes it)
+        from perf_wallclock import control_main
+
+        return control_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

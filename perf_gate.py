@@ -529,6 +529,48 @@ def gate_watchdog(art_dir: str, out=sys.stdout) -> int:
     return 0
 
 
+def gate_control(art_dir: str, out=sys.stdout) -> int:
+    """The control-loop overhead commitment (ISSUE 16), from
+    ``BENCH_control.json`` (``python bench.py --control``): one
+    remediation decision sweep (verification tick for the in-flight
+    action plus the open-incident mapping guards), priced at the
+    measured p99, must cost <= ``decide_frac_max`` (1%) of one
+    steady-state train iteration at the committed headline geometry —
+    the control loop steers the workload, it must never become one.
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_control.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_control.json — control loop not "
+              "measured (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_control.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    # default mirrors the producer's bound (perf_wallclock.py
+    # CONTROL_DECIDE_FRAC_MAX) so a field-less artifact can't flip the
+    # verdict
+    frac_max = float(data.get("decide_frac_max", 0.01))
+    frac = data.get("decide_frac_of_iter", data.get("value"))
+    iter_ms = data.get("iter_ms")
+    line = (
+        f"perf_gate: remediation decision sweep p99 {float(frac):.3%} "
+        "of the iteration"
+        + (f" ({float(iter_ms):.1f} ms)" if iter_ms is not None else "")
+        + f", commitment <= {frac_max:.0%}"
+    )
+    if float(frac) > frac_max:
+        print(line + " — THE CONTROL LOOP BECAME THE WORKLOAD", file=out)
+        return 1
+    print(line + " — ok", file=out)
+    return 0
+
+
 def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
     committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
@@ -590,14 +632,14 @@ def gate_tier1(art_dir: str, out=sys.stdout) -> int:
 
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
     # the experience-plane, act-path, gateway, ops-plane, trace,
-    # watchdog, and tier-1 budget gates are independent of the BENCH_r*
-    # trail: run them first and fold their verdicts into every return
-    # path
+    # watchdog, control, and tier-1 budget gates are independent of the
+    # BENCH_r* trail: run them first and fold their verdicts into every
+    # return path
     xp_rc = max(
         gate_experience(art_dir, out=out), gate_act(art_dir, out=out),
         gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
         gate_trace(art_dir, out=out), gate_watchdog(art_dir, out=out),
-        gate_tier1(art_dir, out=out),
+        gate_control(art_dir, out=out), gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

@@ -1546,6 +1546,103 @@ def _watchdog_lines() -> list[str]:
     return lines
 
 
+def _load_control_bench():
+    """Load the control-loop artifact (``BENCH_control.json``, written
+    by ``bench.py --control``) if present — same BENCH_host.json
+    discipline: PERF.md regens preserve the measured section without
+    re-running the campaign."""
+    try:
+        with open("BENCH_control.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or data.get("value") is None:
+        return None  # failed-campaign artifact
+    return data
+
+
+def _control_lines() -> list[str]:
+    """The 'Closed-loop control' PERF.md section: static mechanism text
+    plus the measured decision-sweep table from the BENCH_control.json
+    artifact. One function so ``main()`` and the committed PERF.md
+    cannot drift."""
+    lines = [
+        "",
+        "## Closed-loop control & load generator",
+        "",
+        "ISSUE 15 diagnoses; ISSUE 16 acts. `session/remediate.py` runs "
+        "one bounded decision sweep per metrics cadence: the open "
+        "incident's top-ranked cause tier maps to exactly one action on "
+        "an existing actuator (fleet `scale_up`, per-tenant admission "
+        "`set_quota` throttle/shed, RespawnSchedule-backed targeted "
+        "restart, learner batch/precision downshift), guarded in order "
+        "by a per-run action budget, per-kind cooldowns, and one-action-"
+        "per-incident in flight. Every action is journaled atomically "
+        "(`telemetry/actions/action-<n>.json`, `remediation` events, "
+        "`remediation/*` gauges) and watched by a counter-detector: the "
+        "action's objective is sampled for `verify_windows` post-action "
+        "sweeps, and an action whose objective regressed further is "
+        "ruled ineffective and reverted where reversible — counted, "
+        "never silent. `gateway/loadgen.py` replays the PR-12 chaos "
+        "sites as tenant traffic (steady pacing, attach storms, hot-key "
+        "hammering, act bursts, adversarial frames) so the loop is "
+        "exercised against production-shaped load.",
+    ]
+    ct = _load_control_bench()
+    if ct:
+        dec = ct.get("decide_ms") or {}
+        lines += [
+            "",
+            f"Measured at the production census ({ct.get('workload', 'benchmark workload')}; "
+            f"`BENCH_control.json`, platform `{ct.get('platform')}`):",
+            "",
+            "| Cost | p50 ms | p99 ms |",
+            "|---|---|---|",
+        ]
+        p50, p99 = dec.get("p50"), dec.get("p99")
+        lines.append(
+            "| remediation decision sweep (action in flight) | {a} | {b} |".format(
+                a=f"{float(p50):.4f}" if p50 is not None else "n/a",
+                b=f"{float(p99):.4f}" if p99 is not None else "n/a",
+            )
+        )
+        e2e = ct.get("incident_to_action_ms")
+        if e2e is not None:
+            lines.append(
+                f"| incident -> journaled action e2e (detect + map + "
+                f"actuate + write) | {float(e2e):.4f} | — |"
+            )
+        lg = ct.get("loadgen") or {}
+        if lg.get("acts_per_s") is not None:
+            lines += [
+                "",
+                (
+                    f"The load generator sustained "
+                    f"{float(lg['acts_per_s']):.1f} acts/s against a "
+                    f"live fleet + gateway "
+                    f"(offered {float(lg.get('offered_hz', 0)):.0f} Hz, "
+                    f"client act RTT "
+                    f"{float(lg.get('act_rtt_ms', 0)):.2f} ms mean)."
+                ),
+            ]
+        frac = ct.get("decide_frac_of_iter")
+        iter_ms = ct.get("iter_ms")
+        lines += [
+            "",
+            (
+                f"The decision sweep p99 costs {float(frac):.3%} of the "
+                f"{float(iter_ms):.0f} ms steady-state iteration "
+                f"(commitment <= "
+                f"{float(ct.get('decide_frac_max', 0.01)):.0%})"
+                if frac is not None and iter_ms is not None
+                else "The overhead fraction was not recorded"
+            )
+            + ". Gated by `perf_gate.gate_control`, folded into "
+            "`gate()`.",
+        ]
+    return lines
+
+
 def _load_tune_bench():
     """Load the autotuner artifact (``BENCH_tune.json``, written by
     ``surreal_tpu tune ... --out BENCH_tune.json``) if present — like
@@ -2197,6 +2294,7 @@ def main(argv=None) -> None:
     lines += _ops_plane_lines()
     lines += _trace_lines()
     lines += _watchdog_lines()
+    lines += _control_lines()
     if scaling:
         lines += [
             "",

@@ -1483,6 +1483,247 @@ def watchdog_main(argv) -> int:
     return 0
 
 
+# -- remediation control loop & loadgen (--control) ---------------------------
+
+CONTROL_SWEEPS = 300     # decision-sweep sample size (action in flight)
+CONTROL_WARMUP = 40      # healthy sweeps to arm the watchdog baselines
+CONTROL_LOADGEN_S = 1.5  # sustained-rate window against a live gateway
+# the overhead commitment gate_control enforces: one remediation decision
+# sweep (verification tick for the in-flight action + open-incident
+# mapping guards) costs <= 1% of one steady-state train iteration — the
+# control loop steers the workload, it must never become one
+CONTROL_DECIDE_FRAC_MAX = 0.01
+
+
+def _control_snap(i: int, rows, anomalous: bool = False) -> dict:
+    """``_watchdog_snap`` plus the per-replica ``fleet/serve_ms`` gauge
+    the remediation counter-detector reads as its fleet objective, so
+    verification samples are real values rather than skipped Nones."""
+    snap = _watchdog_snap(i, rows, anomalous=anomalous)
+    serve = 80.0 if anomalous else 2.0
+    for name, tier in snap["tiers"].items():
+        if name.startswith("fleet"):
+            tier["gauges"]["fleet/serve_ms"] = serve
+    return snap
+
+
+def _control_measure() -> dict:
+    """The control campaign (standalone — no training run): incident ->
+    journaled-action end-to-end latency (anomalous snapshot in ->
+    action-1.json on disk), per-sweep remediation decision cost with an
+    action in verification flight, and the tenant load generator's
+    sustained act rate against a live fleet + gateway."""
+    import tempfile
+
+    import numpy as np
+
+    from surreal_tpu.session.incidents import IncidentEngine
+    from surreal_tpu.session.remediate import ACTIONS_DIR, RemediationEngine
+    from surreal_tpu.session.telemetry import TELEMETRY_DIR
+    from surreal_tpu.session.watchdog import Watchdog
+
+    def pctl(samples_ms):
+        arr = np.asarray(samples_ms)
+        return {
+            "p50": round(float(np.percentile(arr, 50)), 5),
+            "p99": round(float(np.percentile(arr, 99)), 5),
+        }
+
+    class _BenchFleet:
+        """Bounded fake actuator: the engine's fleet_scale_up target."""
+
+        def __init__(self):
+            self.n = 2
+
+        def scale_up(self):
+            self.n += 1
+            return self.n - 1
+
+        def scale_down(self, replica=None):
+            self.n -= 1
+            return True
+
+    rows = _ops_rows()
+    decide_ms = []
+    with tempfile.TemporaryDirectory() as folder:
+        wd = Watchdog(
+            baseline_rows=[{
+                "file": "BENCH_bench.json", "round": 0,
+                "metric": "env_steps_per_sec_bench", "value": 9.0e4,
+                "platform": None, "geometry": None, "mfu": 0.5,
+                "arm": None, "failed": False,
+            }],
+        )
+        eng = IncidentEngine(folder=folder, trace_id="bench")
+        rem = RemediationEngine(
+            folder=folder, incidents=eng, trace_id="bench",
+            cfg={
+                # keep the one action verifying for the whole timed
+                # phase, and never re-act: the priced sweep is the
+                # steady in-flight state (verify tick + guards)
+                "verify_windows": CONTROL_SWEEPS + CONTROL_WARMUP + 4,
+                "cooldown_s": 1e9,
+            },
+        )
+        rem.bind_actuators(fleet=_BenchFleet())
+        for i in range(CONTROL_WARMUP):
+            snap = _control_snap(i, rows)
+            firings = wd.evaluate(snap)
+            eng.observe(firings, snap)
+            rem.step(firings, snap)
+        # incident -> action e2e: anomalous snapshot in -> incident
+        # opens (liveness fires on the FIRST anomalous sweep) -> the
+        # engine maps its top cause to fleet_scale_up and journals
+        # action-1.json, all inside one decision sweep.
+        i0 = CONTROL_WARMUP
+        t0 = time.perf_counter()
+        snap = _control_snap(i0, rows, anomalous=True)
+        firings = wd.evaluate(snap)
+        eng.observe(firings, snap)
+        rem.step(firings, snap)
+        act_e2e_ms = (time.perf_counter() - t0) * 1e3
+        import os as _os
+
+        rec = _os.path.join(folder, TELEMETRY_DIR, ACTIONS_DIR,
+                            "action-1.json")
+        if not _os.path.isfile(rec):
+            raise RuntimeError(
+                "anomalous snapshot did not produce a journaled action"
+            )
+        # steady decision sweeps with the action in verification flight:
+        # the incident stays open, the engine samples the objective and
+        # declines to stack a second action — the per-cadence cost the
+        # frac gate prices.
+        for i in range(i0 + 1, i0 + 1 + CONTROL_SWEEPS):
+            snap = _control_snap(i, rows, anomalous=True)
+            firings = wd.evaluate(snap)
+            eng.observe(firings, snap)
+            t0 = time.perf_counter()
+            rem.step(firings, snap)
+            decide_ms.append((time.perf_counter() - t0) * 1e3)
+        if rem.executed != 1:
+            raise RuntimeError(
+                f"expected exactly one executed action, got {rem.executed}"
+            )
+    loadgen = _control_loadgen()
+    iter_ms = _ops_iter_ms()
+    dec = pctl(decide_ms)
+    return {
+        "decide_ms": dec,
+        "incident_to_action_ms": round(act_e2e_ms, 4),
+        "iter_ms": round(iter_ms, 3),
+        "decide_frac_of_iter": round(dec["p99"] / iter_ms, 5),
+        "sweeps": CONTROL_SWEEPS,
+        "loadgen": loadgen,
+        "workload": (
+            f"{len(rows)} wire tiers + learner row, open incident with "
+            "fleet_scale_up in verification flight; "
+            "iter: PPO jax:cartpole 512x64 (1 epoch)"
+        ),
+    }
+
+
+def _control_loadgen() -> dict:
+    """Sustained tenant act rate: two steady tenants against a live
+    InferenceFleet + GatewayServer for ``CONTROL_LOADGEN_S`` seconds —
+    achieved acts/s vs the offered rate, plus the client-side RTT."""
+    import numpy as np
+
+    from surreal_tpu.distributed.fleet import InferenceFleet
+    from surreal_tpu.gateway import GatewayServer
+    from surreal_tpu.gateway.loadgen import LoadGenerator
+
+    def act_fn(obs):
+        b = obs.shape[0]
+        return (
+            np.zeros(b, np.int32),
+            {"logp": np.full(b, -np.log(2), np.float32)},
+        )
+
+    offered_hz = 100.0  # 2 tenants x 50 Hz
+    fleet = InferenceFleet(act_fn, num_workers=2, replicas=2,
+                           unroll_length=4)
+    server = GatewayServer(fleet, lease_s=30.0)
+    gen = LoadGenerator(
+        server.address,
+        tenants=[
+            {"tenant": "steady-0", "profile": "steady", "rate_hz": 50.0},
+            {"tenant": "steady-1", "profile": "steady", "rate_hz": 50.0},
+        ],
+        obs_shape=(1, 4), timeout_s=5.0, retries=2,
+    )
+    try:
+        gen.start()
+        t0 = time.perf_counter()
+        time.sleep(CONTROL_LOADGEN_S)
+        elapsed = time.perf_counter() - t0
+        rep = gen.stop()
+    finally:
+        server.close()
+        fleet.close()
+    errors = [t["error"] for t in rep["tenants"].values() if t["error"]]
+    if errors:
+        raise RuntimeError(f"loadgen tenant died: {errors[0]}")
+    return {
+        "offered_hz": offered_hz,
+        "acts_per_s": round(rep["loadgen/acts"] / elapsed, 2),
+        "act_rtt_ms": round(rep["loadgen/act_rtt_ms"], 4),
+        "window_s": round(elapsed, 3),
+    }
+
+
+def control_main(argv) -> int:
+    """--control driver (ISSUE 16): per-cadence cost of the remediation
+    decision sweep, the incident -> journaled-action latency, and the
+    load generator's sustained rate. Writes ``BENCH_control.json``
+    (perf_gate.gate_control and PERF.md's generated section consume
+    it), with bench.py's bounded retry/backoff and structured failed-
+    round artifact."""
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_control.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            row = _control_measure()
+            result = {
+                "metric": "control_decide_frac_of_iter",
+                "value": row["decide_frac_of_iter"],
+                "unit": "frac",
+                "geometry": row["workload"],
+                "decide_frac_max": CONTROL_DECIDE_FRAC_MAX,
+                **row,
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"control attempt {attempt + 1}/{RETRY_ATTEMPTS} "
+                    f"failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -1502,6 +1743,8 @@ def main(argv=None) -> None:
         sys.exit(trace_main(argv))
     if "--watchdog" in argv:
         sys.exit(watchdog_main(argv))
+    if "--control" in argv:
+        sys.exit(control_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

@@ -91,6 +91,7 @@ class AdmissionController:
         self.throttled_acts = 0
         self.evicted_requests = 0
         self.expired_leases = 0
+        self.quota_changes = 0
 
     def tenant(self, name: str) -> _Tenant:
         t = self._tenants.get(name)
@@ -102,6 +103,35 @@ class AdmissionController:
 
     def tenants(self) -> dict[str, _Tenant]:
         return self._tenants
+
+    # -- runtime quota mutation (ISSUE 16) -----------------------------------
+    def quota_of(self, name: str) -> dict:
+        """The quota dict currently governing ``name`` (named entry or
+        the default) — what ``set_quota`` must be handed to restore it."""
+        return dict(self._quotas.get(name, self._default))
+
+    def set_quota(self, name: str, quota: dict) -> dict:
+        """Replace tenant ``name``'s quota at runtime — the remediation
+        engine's throttle/shed actuator, and the operator path that
+        makes a quota change a config action instead of a gateway
+        restart. Counted (``quota_changes``); returns the PREVIOUS
+        quota dict so the caller can revert.
+
+        The live ``_Tenant`` keeps its queue and counters (history is
+        evidence); only the bucket and limits are rebuilt, so a reduced
+        rate takes effect on the very next act."""
+        prev = self.quota_of(name)
+        quota = dict(quota)
+        self._quotas[name] = quota
+        t = self._tenants.get(name)
+        if t is not None:
+            t.bucket = TokenBucket(
+                float(quota.get("rate", 0.0)), float(quota.get("burst", 1.0))
+            )
+            t.max_sessions = int(quota.get("max_sessions", 0))
+            t.queue_depth = max(1, int(quota.get("queue_depth", 64)))
+        self.quota_changes += 1
+        return prev
 
     # -- session admission ---------------------------------------------------
     def admit_session(self, name: str, tenant_sessions: int,
@@ -178,4 +208,5 @@ class AdmissionController:
             "gateway/evicted_requests": float(self.evicted_requests),
             "gateway/expired_leases": float(self.expired_leases),
             "gateway/queued_acts": float(self.queued()),
+            "gateway/quota_changes": float(self.quota_changes),
         }

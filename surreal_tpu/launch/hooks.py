@@ -296,6 +296,25 @@ class SessionHooks:
                 exemplar_source=self.tracer.recent_exemplar_spans,
                 trace_id=self.trace_id,
             )
+        # closed-loop remediation (ISSUE 16): the incident stream's top
+        # cause mapped to ONE bounded, journaled, counter-detected action
+        # per sweep. Rides the incident engine (no incidents, nothing to
+        # remediate); actuators are bound later by the driver
+        # (bind_remediation_actuators) once the fleet/gateway exist.
+        self.remediate = None
+        rem_cfg = cfg.get("remediate", None)
+        if self.incidents is not None and (
+            rem_cfg is None or rem_cfg.get("enabled", True)
+        ):
+            from surreal_tpu.session.remediate import RemediationEngine
+
+            self.remediate = RemediationEngine(
+                folder=cfg.folder,
+                cfg=rem_cfg,
+                incidents=self.incidents,
+                on_event=self.tracer.event,
+                trace_id=self.trace_id,
+            )
         self._last_eval: dict[str, float] = {}
         self._last_train: dict[str, float] = {}
         self._metrics_every = PeriodicTracker(max(1, cfg.metrics.every_n_iters))
@@ -313,6 +332,14 @@ class SessionHooks:
     def last_metrics(self) -> dict[str, float]:
         """Latest synced train metrics merged with latest eval metrics."""
         return {**self._last_train, **self._last_eval}
+
+    def bind_remediation_actuators(self, **surfaces) -> None:
+        """Hand the remediation engine its actuator surfaces (fleet,
+        admission, restart map, learner downshift/restore) once the
+        driver has built them — no-op when remediation is off. See
+        :meth:`RemediationEngine.bind_actuators`."""
+        if self.remediate is not None:
+            self.remediate.bind_actuators(**surfaces)
 
     def data_plane_event(self, **info) -> None:
         """Record the SEED data plane's negotiated shape (transport mix,
@@ -636,6 +663,13 @@ class SessionHooks:
                 self.incidents.observe(firings, snap)
                 m.update(self.watchdog.gauges())
                 m.update(self.incidents.gauges())
+                # remediation decision sweep: the incident just observed
+                # -> at most one bounded action + verification ticks for
+                # the actions already in flight. Same pure-host-dict
+                # discipline, same transfer-guard.
+                if self.remediate is not None:
+                    self.remediate.step(firings, snap)
+                    m.update(self.remediate.gauges())
             self._last_train = m
         if m or evaled:
             self.writer.write(env_steps, {**(m or {}), **evaled})
@@ -747,9 +781,12 @@ class SessionHooks:
             self.ops.record_fault(ev)
             if self.incidents is not None:
                 self.incidents.record_fault(ev)
-        # flush a still-open incident to disk (closed_t stays None — the
-        # record shows the run ended mid-incident) before the planes it
-        # reads from come down
+        # flush still-verifying actions (a run ending mid-verification is
+        # itself evidence), then a still-open incident (closed_t stays
+        # None — the record shows the run ended mid-incident), before the
+        # planes they read from come down
+        if self.remediate is not None:
+            self.remediate.close()
         if self.incidents is not None:
             self.incidents.close()
         # stop the ops receiver BEFORE the tiers that push into it come

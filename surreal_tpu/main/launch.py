@@ -890,10 +890,12 @@ def run_why(args) -> int:
     """Root-caused incident reports from the watchdog/incident engine
     (session/incidents.py): what fired, the ranked cause hypotheses with
     their correlated evidence (faults, respawns, SLO breaches, slowest
-    exemplar spans), and where the auto-captured profile/flight-recorder
-    artifacts landed. Pure file reading over telemetry/incidents/ — no
-    jax, no zmq — so it works off-chip and against a live run, like
-    ``diag``/``top``/``trace``."""
+    exemplar spans), where the auto-captured profile/flight-recorder
+    artifacts landed, and — when the remediation engine acted — the
+    Actions section (cause -> action -> verdict, reverts marked;
+    session/remediate.py). Pure file reading over telemetry/incidents/
+    and telemetry/actions/ — no jax, no zmq — so it works off-chip and
+    against a live run, like ``diag``/``top``/``trace``."""
     from surreal_tpu.session.incidents import incidents_report
 
     if not os.path.isdir(args.folder):
@@ -1067,7 +1069,8 @@ def main(argv=None) -> int:
     w = sub.add_parser("why", help="root-caused incident reports from "
                        "the watchdog (what fired, ranked cause "
                        "hypotheses, correlated faults/SLO breaches/"
-                       "exemplars, auto-captured artifacts)")
+                       "exemplars, auto-captured artifacts, remediation "
+                       "actions with counter-detector verdicts)")
     w.add_argument("folder", help="session folder (holds telemetry/)")
     w.add_argument("--incident", type=int, default=None,
                    help="render one incident in full detail (default: "

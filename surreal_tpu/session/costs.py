@@ -258,6 +258,58 @@ GAUGE_REGISTRY = {
     "trace/dropped_spans": _g("count",
         'spans dropped by the trace.emit chaos site — counted, never '
         "silent; the exemplar's tree renders with the torn hop marked."),
+    # -- closed-loop remediation (session/remediate.py; ISSUE 16) -----------
+    "remediation/actions": _g("count",
+        'bounded actions executed by the remediation engine (each a '
+        'remediation event, an atomic telemetry/actions/action-<n>.json '
+        "record, and evidence on the incident that triggered it)."),
+    "remediation/suppressed": _g("count",
+        'would-be actions stopped by the global max_actions budget or a '
+        'per-kind cooldown — loud (a counted remediation event), never a '
+        'silent retry loop.'),
+    "remediation/unmapped": _g("count",
+        "decision sweeps where the open incident's top cause had no bound "
+        'actuator or no actionable target — counted, never guessed.'),
+    "remediation/reverted": _g("count",
+        'actions undone by the counter-detector after their triggering '
+        'objective regressed further (quota restored, replica drained, '
+        'overrides rolled back).'),
+    "remediation/ineffective": _g("count",
+        'actions the counter-detector judged ineffective over '
+        'verify_windows post-action sweeps.'),
+    "remediation/effective": _g("count",
+        'actions whose triggering objective did NOT regress further over '
+        'the verification window.'),
+    "remediation/errors": _g("count",
+        'actuator calls that raised (execute or revert) — journaled and '
+        'counted; actuation must never kill training.'),
+    "remediation/active": _g("count",
+        'actions currently inside their verification window.'),
+    # -- tenant load generator (gateway/loadgen.py; ISSUE 16) ---------------
+    "gateway/quota_changes": _g("count",
+        'runtime per-tenant quota mutations via AdmissionController.'
+        'set_quota (operator reconfigs and remediation throttles alike).'),
+    "loadgen/tenants": _g("count",
+        'tenant threads in the generator mix (steady + abusive profiles).'),
+    "loadgen/attaches": _g("count",
+        'sessions the generator attached across all tenants.'),
+    "loadgen/detaches": _g("count",
+        'sessions the generator detached (attach_storm churns these).'),
+    "loadgen/acts": _g("count",
+        'acts served to generator tenants end-to-end.'),
+    "loadgen/act_errors": _g("count",
+        'acts answered with a counted gateway rejection (throttle '
+        'eviction, quota, dead session) — the expected outcome for the '
+        'abusive profiles.'),
+    "loadgen/rejected": _g("count",
+        'attach attempts denied by admission control.'),
+    "loadgen/timeouts": _g("count",
+        'acts that exhausted client retries without a reply.'),
+    "loadgen/hostile_frames": _g("count",
+        'malformed frames the adversarial profile put on the wire (each '
+        "must land in the server's gateway/bad_frames, never a crash)."),
+    "loadgen/act_rtt_ms": _g("ms",
+        'mean client-observed act round-trip across generator tenants.'),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

@@ -461,6 +461,29 @@ BASE_SESSION_CONFIG = Config(
         max_captures=4,          # auto profile+flightrec captures per run
         capture_cooldown_s=60.0,
     ),
+    # closed-loop remediation (ISSUE 16, session/remediate.py): once per
+    # metrics cadence — after the watchdog sweep and the incident
+    # observe — the engine maps the open incident's top-ranked cause
+    # tier to ONE bounded action on an existing actuator (fleet
+    # scale_up, per-tenant throttle via AdmissionController.set_quota,
+    # RespawnSchedule-backed targeted restart, learner batch/precision
+    # downshift via the config overrides path). Every action is a
+    # counted `remediation` event + an atomic
+    # telemetry/actions/action-<n>.json record + evidence on its
+    # incident; a counter-detector watches the triggering objective for
+    # verify_windows post-action sweeps and reverts what regressed
+    # further. Suppressions (budget/cooldown) are loud, never silent.
+    remediate=Config(
+        enabled=True,
+        max_actions=8,        # global per-run action budget
+        cooldown_s=30.0,      # per-action-kind cooldown
+        verify_windows=4,     # post-action sweeps before a verdict
+        regress_margin=0.1,   # "regressed further" relative margin
+        throttle_factor=0.5,  # tenant quota multiplier per throttle
+        min_rate=1.0,         # throttled tenants never drop below this
+        shed_rate=50.0,       # rate applied when the old quota was
+                              # unlimited (rate=0 has nothing to scale)
+    ),
     eval=Config(
         every_n_iters=100,
         episodes=5,

@@ -215,6 +215,32 @@ class IncidentEngine:
         self.closed = 0
         self._write_ok = folder is not None
 
+    @property
+    def open_incident(self) -> dict | None:
+        """The currently open incident record (None between incidents) —
+        the remediation engine's read surface."""
+        return self._open
+
+    def attach_action(self, summary: dict) -> None:
+        """First-class action evidence (ISSUE 16): fold a remediation
+        action summary into the open incident and persist immediately —
+        an action must be visible in the record it answered, not only in
+        the action journal. Verdict updates for an action id replace the
+        earlier summary in place (one line per action in ``why``)."""
+        inc = self._open
+        if inc is None:
+            return
+        actions = inc["evidence"].setdefault("actions", [])
+        summary = dict(summary)
+        for i, a in enumerate(actions):
+            if a.get("action") == summary.get("action"):
+                actions[i] = summary
+                break
+        else:
+            actions.append(summary)
+        del actions[32:]
+        self._write(inc)
+
     # -- evidence feeds (called by SessionHooks next to the ops feeds) -------
     def record_fault(self, ev: dict) -> None:
         rec = dict(ev)
@@ -500,7 +526,7 @@ def _incident_lines(inc: dict, verbose: bool = True) -> list[str]:
             + ", ".join(
                 f"{len(ev.get(k) or [])} {k}"
                 for k in ("faults", "slo_breaches", "exemplars",
-                          "dead_tiers", "recoveries")
+                          "dead_tiers", "recoveries", "actions")
                 if ev.get(k)
             )
             + (f"; {len(counts)} detector(s)" if counts else "")
@@ -581,6 +607,17 @@ def _incident_lines(inc: dict, verbose: bool = True) -> list[str]:
                     f"{_fmt_value(row.get('dur_ms'), 'ms')}, tier "
                     f"{row.get('tier', '?')}"
                 )
+    actions = ev.get("actions") or []
+    if actions:
+        lines.append("  actions taken (cause -> action -> verdict):")
+        for a in actions[:8]:
+            lines.append(
+                f"    #{a.get('action')} {a.get('cause_tier', '?'):<12}"
+                f" -> {a.get('kind', '?'):<18}"
+                f" {a.get('detail', '')}"
+                f" -> {a.get('verdict') or 'verifying'}"
+                + (" (reverted)" if a.get("reverted") else "")
+            )
     arts = inc.get("artifacts") or {}
     art_bits = [
         f"{k} {v}" for k, v in sorted(arts.items())
@@ -627,6 +664,15 @@ def incidents_report(folder: str, incident: int | None = None) -> str | None:
     for inc in incidents:
         lines.append("")
         lines += _incident_lines(inc, verbose=True)
+    # the run-level Actions section (ISSUE 16): the remediation journal
+    # rendered cause -> action -> verdict, incident-filtered when one id
+    # was requested (pure file reading, same discipline as the rest)
+    from surreal_tpu.session.remediate import actions_report_lines
+
+    act_lines = actions_report_lines(folder, incident=incident)
+    if act_lines:
+        lines.append("")
+        lines += act_lines
     return "\n".join(lines)
 
 

@@ -541,6 +541,17 @@ def top_report(snap: dict | None, folder: str | None = None) -> str:
             lines += [
                 "", "Incidents (surreal_tpu why for the full report)",
             ] + inc_lines
+        # live remediation state (ISSUE 16): the newest journaled actions
+        # under <folder>/telemetry/actions/ — an executing/verifying
+        # action shows up within one refresh, same pure-file-read rule
+        try:
+            from surreal_tpu.session.remediate import actions_brief
+
+            act_lines = actions_brief(folder)
+        except Exception:
+            act_lines = []
+        if act_lines:
+            lines += ["", "Remediation"] + act_lines
     return "\n".join(lines)
 
 

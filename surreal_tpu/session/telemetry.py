@@ -202,6 +202,11 @@ EVENT_REGISTRY = {
                        "(session/incidents.py, rate-bounded)",
     "incident_close": "incident closed on sustained-healthy windows "
                       "(session/incidents.py)",
+    "remediation": "remediation engine action executed/suppressed/errored "
+                   "(session/remediate.py)",
+    "remediation_verdict": "counter-detector verdict on a completed "
+                           "verification window (session/remediate.py)",
+    "loadgen": "tenant load generator stop summary (gateway/loadgen.py)",
 }
 
 

@@ -320,30 +320,24 @@ def test_delay_sample_fault_is_absorbed():
         faults.configure(None)
 
 
-def test_kill_shard_respawns_learner_keeps_training(tmp_path):
-    """The chaos satellite: a killed shard server respawns under the
-    exponential-backoff schedule while training keeps going on the
-    surviving shard; no /dev/shm leak survives the cycle. The same run
-    doubles as the observability acceptance: every emitted experience/*
-    gauge is registry-documented, and diag renders the Experience plane
-    section (per-shard table + sample-wait) from the run's
-    experience_plane events."""
-    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
-    from surreal_tpu.session.costs import GAUGE_REGISTRY
-    from surreal_tpu.session.telemetry import diag_report, diag_summary
-
-    folder = tmp_path / "xp_kill"
-    cfg = Config(
+def _kill_shard_cfg(folder, *, total_env_steps, updates_per_iter,
+                    batch_size, kill_at):
+    """The kill-shard chaos topology shared by the fast and slow arms:
+    2 thread-mode shm shards, a kill_shard fault mid-run, tight plane
+    timeouts so the respawn cycle fits the budget."""
+    return Config(
         learner_config=Config(
-            algo=Config(name="ddpg", horizon=8, updates_per_iter=2,
+            algo=Config(name="ddpg", horizon=8,
+                        updates_per_iter=updates_per_iter,
                         exploration=Config(warmup_steps=0)),
             replay=Config(kind="remote", remote_kind="uniform",
-                          capacity=512, start_sample_size=16, batch_size=32),
+                          capacity=512, start_sample_size=16,
+                          batch_size=batch_size),
         ),
         env_config=Config(name="gym:Pendulum-v1", num_envs=4),
         session_config=Config(
             folder=str(folder),
-            total_env_steps=8 * 4 * 6,
+            total_env_steps=total_env_steps,
             metrics=Config(every_n_iters=1, tensorboard=False, console=False),
             checkpoint=Config(every_n_iters=0),
             eval=Config(every_n_iters=0),
@@ -353,10 +347,58 @@ def test_kill_shard_respawns_learner_keeps_training(tmp_path):
                 watermark_timeout_s=0.5, respawn_backoff_s=0.05,
             )),
             faults=Config(plan=[
-                {"site": "experience.shard", "kind": "kill_shard", "at": 10},
+                {"site": "experience.shard", "kind": "kill_shard",
+                 "at": kill_at},
             ]),
         ),
     ).extend(base_config())
+
+
+def test_kill_shard_respawns_fast(tmp_path):
+    """Tier-1 trim of the kill-shard chaos run (ISSUE 16 headroom
+    satellite): the SAME respawn/renegotiation path — a killed thread
+    shard respawns under the schedule while training continues on the
+    survivor, no /dev/shm leak — at the minimum workload that still
+    trains past the kill (fewer iterations, one update per iteration).
+    The full-size run with the diag/registry acceptance sweep rides the
+    slow tier below."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    cfg = _kill_shard_cfg(
+        tmp_path / "xp_kill_fast", total_env_steps=8 * 4 * 3,
+        updates_per_iter=1, batch_size=16, kill_at=4,
+    )
+    trainer = OffPolicyTrainer(cfg)
+    state, metrics = trainer.run()
+    assert np.isfinite(metrics["loss/critic"])
+    assert metrics["experience/respawns"] >= 1.0, metrics
+    assert metrics["experience/shards_live"] == 2.0
+    assert metrics["time/env_steps"] >= 8 * 4 * 3
+    assert not glob.glob("/dev/shm/surreal_xp_*"), "respawn cycle leaked shm"
+
+
+@pytest.mark.slow
+def test_kill_shard_respawns_learner_keeps_training(tmp_path):
+    """The chaos satellite: a killed shard server respawns under the
+    exponential-backoff schedule while training keeps going on the
+    surviving shard; no /dev/shm leak survives the cycle. The same run
+    doubles as the observability acceptance: every emitted experience/*
+    gauge is registry-documented, and diag renders the Experience plane
+    section (per-shard table + sample-wait) from the run's
+    experience_plane events.
+
+    Slow tier: the full-size run (6 cadences, 2 updates/iter) costs
+    ~70 s on the one-core suite; test_kill_shard_respawns_fast keeps
+    the respawn path in tier-1."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+    from surreal_tpu.session.costs import GAUGE_REGISTRY
+    from surreal_tpu.session.telemetry import diag_report, diag_summary
+
+    folder = tmp_path / "xp_kill"
+    cfg = _kill_shard_cfg(
+        folder, total_env_steps=8 * 4 * 6, updates_per_iter=2,
+        batch_size=32, kill_at=10,
+    )
     trainer = OffPolicyTrainer(cfg)
     state, metrics = trainer.run()
     assert np.isfinite(metrics["loss/critic"])

@@ -292,6 +292,10 @@ _DATA_PLANE_STEADY_STATE = (
     "gateway/server.py",
     "gateway/admission.py",
     "gateway/table.py",
+    # the tenant load generator (ISSUE 16): client-side traffic over the
+    # real GatewaySession codec — its adversarial profile sends raw
+    # hostile bytes, never a pickle of its own
+    "gateway/loadgen.py",
 )
 
 
@@ -364,11 +368,11 @@ def test_perf_gauges_appear_in_registry():
     """Gauge-registry lint (ISSUE 6 satellite, extended by ISSUE 8 over
     the replay/experience families, ISSUE 10 over the serving-tier
     fleet/param families, ISSUE 12 over the gateway family, ISSUE 13
-    over the ops/slo families, and ISSUE 14 over the lineage/trace
-    families): every
+    over the ops/slo families, ISSUE 14 over the lineage/trace
+    families, and ISSUE 16 over the remediation/loadgen families): every
     ``perf/*``, ``replay/*``, ``experience/*``, ``fleet/*``,
-    ``param/*``, ``gateway/*``, ``ops/*``, ``slo/*``, ``lineage/*``, or
-    ``trace/*`` gauge name emitted
+    ``param/*``, ``gateway/*``, ``ops/*``, ``slo/*``, ``lineage/*``,
+    ``trace/*``, ``remediation/*``, or ``loadgen/*`` gauge name emitted
     anywhere in the package must appear in the documented registry
     (``session/costs.py::GAUGE_REGISTRY``) — an undocumented gauge is
     invisible to diag readers and to the README's knob table. The scan
@@ -381,7 +385,7 @@ def test_perf_gauges_appear_in_registry():
 
     lit = re.compile(
         r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo"
-        r"|lineage|trace)"
+        r"|lineage|trace|remediation|loadgen)"
         r"/[a-z0-9_]+)[\"']"
     )
     bad = []
@@ -396,8 +400,8 @@ def test_perf_gauges_appear_in_registry():
                     f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
                 )
     assert not bad, (
-        "perf/replay/experience/fleet/param/gateway/ops/slo/lineage/trace "
-        "gauges emitted "
+        "perf/replay/experience/fleet/param/gateway/ops/slo/lineage/trace/"
+        "remediation/loadgen gauges emitted "
         "but not documented in session/costs.py::GAUGE_REGISTRY:\n"
         + "\n".join(bad)
     )
@@ -405,7 +409,8 @@ def test_perf_gauges_appear_in_registry():
     for name in GAUGE_REGISTRY:
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
-             "gateway/", "ops/", "slo/", "lineage/", "trace/")
+             "gateway/", "ops/", "slo/", "lineage/", "trace/",
+             "remediation/", "loadgen/")
         ), name
 
 

@@ -1,0 +1,132 @@
+"""Tenant load generator (ISSUE 16, gateway/loadgen.py): the PR-12
+chaos sites replayed as traffic against a LIVE gateway — steady pacing,
+attach/detach storms, hot-key hammering, act-rate bursts past the token
+bucket, and adversarial frames the server must count-and-drop. Plus the
+bookkeeping contracts: every outcome counted, gauges registered, fail
+fast on an unknown profile."""
+
+import time
+
+import numpy as np
+import pytest
+
+from surreal_tpu.distributed.fleet import InferenceFleet
+from surreal_tpu.gateway import GatewayServer
+from surreal_tpu.gateway.loadgen import PROFILES, LoadGenerator, default_mix
+
+
+def _act_fn(obs):
+    b = obs.shape[0]
+    return (
+        np.random.randint(0, 2, size=b),
+        {"logp": np.full(b, -np.log(2), np.float32)},
+    )
+
+
+def _stack(**server_kw):
+    fleet = InferenceFleet(_act_fn, num_workers=2, replicas=2,
+                           unroll_length=4)
+    server_kw.setdefault("lease_s", 30.0)
+    server = GatewayServer(fleet, **server_kw)
+    return fleet, server
+
+
+def test_default_mix_is_production_shaped():
+    mix = default_mix(n_steady=3)
+    assert sum(1 for s in mix if s["profile"] == "steady") == 3
+    assert {s["profile"] for s in mix} == set(PROFILES)
+    names = [s["tenant"] for s in mix]
+    assert len(names) == len(set(names))  # distinct tenants
+
+
+def test_unknown_profile_fails_fast():
+    with pytest.raises(ValueError, match="unknown loadgen profile"):
+        LoadGenerator("tcp://127.0.0.1:1", tenants=[
+            {"tenant": "x", "profile": "stampede"},
+        ])
+
+
+def test_loadgen_mix_drives_live_gateway_every_outcome_counted():
+    """The whole mix against a live server: well-behaved tenants get
+    served, the storm churns sessions, the burst outruns its token
+    bucket (server-side throttles/evictions counted), and every hostile
+    frame lands in the server's bad_frames — zero crashes anywhere."""
+    fleet, server = _stack(tenant_quotas={
+        # tight quotas so the abusive profiles actually hit the limits
+        "bursty": {"rate": 10.0, "burst": 2.0, "queue_depth": 2},
+        "hotkey": {"rate": 50.0, "burst": 5.0, "queue_depth": 4},
+    })
+    gen = LoadGenerator(
+        server.address,
+        tenants=[
+            {"tenant": "steady-0", "profile": "steady", "rate_hz": 40.0},
+            {"tenant": "churner", "profile": "attach_storm",
+             "acts_per_life": 1},
+            {"tenant": "hotkey", "profile": "hot_key"},
+            {"tenant": "bursty", "profile": "act_burst",
+             "burst_n": 16, "idle_s": 0.1},
+            {"tenant": "mallory", "profile": "adversarial",
+             "rate_hz": 100.0},
+        ],
+        obs_shape=(1, 4), timeout_s=3.0, retries=2,
+    )
+    events = []
+    gen._on_event = lambda type_, **kw: events.append({"type": type_, **kw})
+    try:
+        gen.start()
+        time.sleep(1.5)
+    finally:
+        rep = gen.stop()
+        server.close()
+        fleet.close()
+    # no tenant thread crashed out of its loop
+    assert all(t["error"] is None for t in rep["tenants"].values()), rep
+    g = gen.gauges()
+    assert g["loadgen/acts"] > 0
+    assert g["loadgen/attaches"] >= 4  # one per well-formed tenant
+    assert g["loadgen/act_rtt_ms"] > 0.0
+    # the storm actually churned
+    churner = rep["tenants"]["churner"]
+    assert churner["attaches"] >= 2 and churner["detaches"] >= 2
+    # hostile bytes flowed and the server counted every one of them
+    assert g["loadgen/hostile_frames"] > 0
+    assert server.gauges()["gateway/bad_frames"] > 0
+    # the burst outran its bucket: counted server-side, never silent
+    assert server.admission.throttled_acts > 0
+    # stop emitted the one summary event with the per-tenant breakdown
+    assert [e["type"] for e in events] == ["loadgen"]
+    assert events[0]["tenants"]["hotkey"]["profile"] == "hot_key"
+    # every emitted gauge is a documented registry name
+    from surreal_tpu.session.costs import GAUGE_REGISTRY
+
+    for name in g:
+        assert name in GAUGE_REGISTRY, name
+
+
+def test_loadgen_rejected_attaches_are_counted_not_fatal():
+    """A tenant at its session quota: the storm's attach denials land in
+    loadgen/rejected and the thread keeps cycling instead of dying."""
+    fleet, server = _stack(tenant_quotas={
+        "churner": {"max_sessions": 1},
+    })
+    # pin the single allowed session so every storm attach is denied
+    from surreal_tpu.gateway import GatewaySession
+
+    pin = GatewaySession(server.address, tenant="churner", obs_shape=(1, 4))
+    gen = LoadGenerator(
+        server.address,
+        tenants=[{"tenant": "churner", "profile": "attach_storm",
+                  "acts_per_life": 1}],
+        obs_shape=(1, 4), timeout_s=2.0,
+    )
+    try:
+        gen.start()
+        time.sleep(0.8)
+    finally:
+        rep = gen.stop()
+        pin.close()
+        server.close()
+        fleet.close()
+    assert rep["loadgen/rejected"] > 0, rep
+    assert rep["tenants"]["churner"]["error"] is None
+    assert server.gauges()["gateway/rejected_sessions"] > 0

@@ -427,8 +427,16 @@ def test_ppo_tuned_program_matches_default(tmp_path, variant, rtol, atol):
 
 @pytest.mark.parametrize(
     "variant",
-    [{"rollout_unroll": 4}, {"update_unroll": 4},
-     {"rollout_unroll": 2, "update_unroll": 2}],
+    [
+        # tier-1 keeps ONE ddpg variant (the rollout unroll — the knob
+        # the autotuner searches first); the other two compile the same
+        # fused program with the same equivalence arithmetic and ride
+        # the slow tier (ISSUE 16 suite-wall headroom satellite)
+        {"rollout_unroll": 4},
+        pytest.param({"update_unroll": 4}, marks=pytest.mark.slow),
+        pytest.param({"rollout_unroll": 2, "update_unroll": 2},
+                     marks=pytest.mark.slow),
+    ],
     ids=["rollout", "update", "both"],
 )
 def test_ddpg_tuned_program_matches_default(tmp_path, variant):

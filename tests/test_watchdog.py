@@ -561,11 +561,16 @@ def test_watchdog_chaos_e2e_incident_names_injected_tier(tmp_path):
 
 @pytest.mark.slow
 def test_watchdog_chaos_e2e_fault_free_control_zero_incidents(tmp_path):
-    """The control arm: the same live topology with NO injected faults
-    and DEFAULT watchdog thresholds opens zero incidents — the detectors
-    must survive a real noisy run without crying wolf."""
+    """The control arm: the same live topology with NO injected faults,
+    DEFAULT watchdog/remediation thresholds, and well-behaved tenant
+    load (gateway/loadgen.py steady profile) opens zero incidents AND
+    executes zero remediation actions — detectors and actuation alike
+    must survive a real noisy run without crying wolf (ISSUE 16's
+    no-false-actuation bar)."""
+    from surreal_tpu.gateway.loadgen import LoadGenerator
     from surreal_tpu.launch.seed_trainer import SEEDTrainer
     from surreal_tpu.main.launch import main
+    from surreal_tpu.session.remediate import load_actions
 
     folder = str(tmp_path)
     cfg = Config(
@@ -581,15 +586,55 @@ def test_watchdog_chaos_e2e_fault_free_control_zero_incidents(tmp_path):
             topology=Config(
                 num_env_workers=2,
                 inference_fleet=Config(replicas=2),
+                gateway=Config(enabled=True, lease_s=10.0),
             ),
         ),
     ).extend(base_config())
     trainer = SEEDTrainer(cfg)
-    state, metrics = trainer.run()
+    gen_holder: list = []
+    stop = threading.Event()
+
+    def traffic():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not stop.is_set():
+            gateway = getattr(trainer, "_gateway", None)
+            if gateway is not None:
+                break
+            time.sleep(0.1)
+        else:
+            return
+        gen = LoadGenerator(
+            gateway.address,
+            tenants=[
+                {"tenant": "steady-0", "profile": "steady",
+                 "rate_hz": 10.0},
+                {"tenant": "steady-1", "profile": "steady",
+                 "rate_hz": 5.0},
+            ],
+            obs_shape=(1, 4), timeout_s=5.0, retries=3,
+        ).start()
+        gen_holder.append(gen)
+        stop.wait(120)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        state, metrics = trainer.run()
+    finally:
+        stop.set()
+        if gen_holder:
+            gen_holder[0].stop()
+        t.join(timeout=15)
     assert metrics["time/env_steps"] >= 600
     assert metrics["ops/watchdog_evals"] >= 1.0
     assert metrics["ops/incidents_total"] == 0.0
     assert load_incidents(folder) == []
+    # the no-false-actuation bar: zero actions, zero suppressions
+    assert metrics.get("remediation/actions", 0.0) == 0.0
+    assert metrics.get("remediation/suppressed", 0.0) == 0.0
+    assert load_actions(folder) == []
+    # the benign tenants were actually served
+    assert gen_holder and gen_holder[0].report()["loadgen/acts"] > 0
     report = incidents_report(folder)
     assert report is not None and "no incidents recorded" in report
     assert main(["why", folder]) == 0
