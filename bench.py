@@ -325,6 +325,15 @@ def main() -> int:
         from perf_wallclock import control_main
 
         return control_main(sys.argv[1:])
+    if "--learner-group" in sys.argv:
+        # elastic learner-group campaign (ISSUE 17): M=1 parity vs the
+        # single learner, per-M learn arms (in-process fallback + the
+        # 8-device-sim all-reduce round) — writes BENCH_lgroup.json +
+        # MULTICHIP_r06.json (perf_gate's learner-group gate consumes
+        # them)
+        from perf_wallclock import learner_group_main
+
+        return learner_group_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

@@ -571,6 +571,86 @@ def gate_control(art_dir: str, out=sys.stdout) -> int:
     return 0
 
 
+def gate_learner_group(art_dir: str, out=sys.stdout) -> int:
+    """The elastic learner-group commitments (ISSUE 17), from
+    ``BENCH_lgroup.json`` + ``MULTICHIP_r06.json`` (``bench.py
+    --learner-group``):
+
+    - M=1 parity: the one-member group's updates/s within ``parity_tol``
+      (2%) of the single learner — the group abstraction is free when
+      unused;
+    - scaling honesty: under mode='scaling' (>= 2 real cores behind the
+      simulated devices) the M=2 all-reduce arm must reach
+      ``scale_min_m2`` (1.6x) over M=1; under mode='honesty' (one core
+      time-slicing the sim) the measured ratios are recorded as-is and
+      only their PRESENCE is enforced — a fabricated speedup can't pass
+      because the mode rides the artifact.
+
+    rc 0 with a note when the artifact is absent or from a failed round.
+    """
+    path = os.path.join(art_dir, "BENCH_lgroup.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_lgroup.json — learner group not "
+              "measured (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_lgroup.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    parity = float(data["value"])
+    tol = float(data.get("parity_tol", 0.02))
+    line = (f"perf_gate: learner-group M=1 parity {parity:.4f}x the "
+            f"single learner, commitment >= {1 - tol:.2f}x")
+    if parity < 1.0 - tol:
+        print(line + " — THE GROUP ABSTRACTION TAXES THE SINGLE-LEARNER "
+              "PATH", file=out)
+        rc = 1
+    else:
+        print(line + " — ok", file=out)
+    # the multichip round: scaling bound in scaling mode, honesty rows
+    # otherwise
+    mc_path = os.path.join(art_dir, "MULTICHIP_r06.json")
+    try:
+        with open(mc_path) as f:
+            mc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no MULTICHIP_r06.json — the 8-device-sim "
+              "all-reduce round was not measured (rc 0)", file=out)
+        return rc
+    if not mc.get("ok") or not mc.get("rounds"):
+        print("perf_gate: MULTICHIP_r06.json records a failed sim round "
+              "(rc 0 — the BENCH_lgroup parity verdict stands)", file=out)
+        return rc
+    rounds = mc["rounds"]
+    m2 = rounds.get("2", {}).get("speedup_vs_m1")
+    mode = str(mc.get("mode", data.get("mode", "honesty")))
+    scale_min = float(mc.get("scale_min_m2", 1.6))
+    if m2 is None:
+        print("perf_gate: MULTICHIP_r06.json has no M=2 round — the "
+              "scaling claim is unmeasured", file=out)
+        return max(rc, 1)
+    if mode == "scaling":
+        line = (f"perf_gate: learner-group M=2 all-reduce {float(m2):.2f}x "
+                f"M=1 on the sim mesh, commitment >= {scale_min:.1f}x")
+        if float(m2) < scale_min:
+            print(line + " — GROUP SCALING COLLAPSED", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    else:
+        print(
+            f"perf_gate: learner-group sim round ran on "
+            f"{mc.get('cores', '?')} core(s) — honesty mode, measured "
+            f"M=2 ratio {float(m2):.2f}x recorded, scaling bound "
+            "deferred to a multi-core round", file=out,
+        )
+    return rc
+
+
 def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
     committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
@@ -639,7 +719,8 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
         gate_experience(art_dir, out=out), gate_act(art_dir, out=out),
         gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
         gate_trace(art_dir, out=out), gate_watchdog(art_dir, out=out),
-        gate_control(art_dir, out=out), gate_tier1(art_dir, out=out),
+        gate_control(art_dir, out=out), gate_learner_group(art_dir, out=out),
+        gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

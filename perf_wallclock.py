@@ -1724,6 +1724,271 @@ def control_main(argv) -> int:
     return 0
 
 
+# -- elastic learner group (--learner-group) ----------------------------------
+
+LGROUP_OBS_DIM = 64
+LGROUP_ACT_DIM = 8
+LGROUP_BATCH = 1024  # rows per SGD update: a learn-bound geometry
+LGROUP_WARM = 2
+LGROUP_MEAS = 15
+LGROUP_REPEATS = 3
+LGROUP_MEMBERS = (1, 2, 4)
+# M=1 parity (ISSUE 17 acceptance): the one-member group dispatches the
+# SAME jitted single-learn program; its Python wrapper must stay within
+# 2% of the single learner's updates/s.
+LGROUP_PARITY_TOL = 0.02
+# the multichip scaling commitment WHEN real cores back the simulated
+# devices (mode='scaling'): learn-bound updates/s at M=2 >= 1.6x M=1.
+# On one core the 8-device CPU sim time-slices a single core, so the
+# artifact reports the honesty ratio under mode='honesty' instead —
+# never a fabricated speedup (the act-path precedent).
+LGROUP_SCALE_MIN_M2 = 1.6
+
+
+def _lgroup_learner():
+    import numpy as np
+
+    from surreal_tpu.envs.base import ArraySpec, EnvSpecs
+    from surreal_tpu.learners import build_learner
+    from surreal_tpu.session.config import Config
+
+    specs = EnvSpecs(
+        obs=ArraySpec(shape=(LGROUP_OBS_DIM,), dtype=np.dtype(np.float32)),
+        action=ArraySpec(shape=(LGROUP_ACT_DIM,), dtype=np.dtype(np.float32)),
+    )
+    learner = build_learner(Config(algo=Config(name="ddpg")), specs)
+    return learner, learner.init(jax.random.key(0))
+
+
+def _lgroup_batch(key):
+    import jax.numpy as jnp
+
+    ks = jax.random.split(key, 4)
+    B = LGROUP_BATCH
+    return {
+        "obs": jax.random.normal(ks[0], (B, LGROUP_OBS_DIM)),
+        "next_obs": jax.random.normal(ks[1], (B, LGROUP_OBS_DIM)),
+        "action": jnp.clip(
+            jax.random.normal(ks[2], (B, LGROUP_ACT_DIM)), -1, 1
+        ),
+        "reward": jax.random.normal(ks[3], (B,)),
+        "discount": jnp.full((B,), 0.99),
+    }
+
+
+def _lgroup_time_learn(learn, state, batch) -> float:
+    """updates/s of one jitted learn program at the committed geometry
+    (state threaded through so every call does real optimizer work).
+    Best of ``LGROUP_REPEATS`` timed windows: the parity bound is 2%,
+    one-core scheduler jitter alone exceeds that in a single window."""
+    key = jax.random.key(7)
+    s = state
+    for _ in range(LGROUP_WARM):
+        key, k = jax.random.split(key)
+        s, m = learn(s, batch, k)
+    jax.block_until_ready(s)
+    best = 0.0
+    for _ in range(LGROUP_REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(LGROUP_MEAS):
+            key, k = jax.random.split(key)
+            s, m = learn(s, batch, k)
+        jax.block_until_ready(s)
+        best = max(best, LGROUP_MEAS / (time.perf_counter() - t0))
+    return best
+
+
+class _LgroupStubPlane:
+    """Just the surface LearnerGroup reads for the learn-path overhead
+    measurement (no live shards: the bench times the LEARN dispatch,
+    sampling is the experience-plane campaign's business)."""
+
+    num_shards = 4
+    _backoff_base = 0.05
+    _backoff_cap = 1.0
+
+    def sampler_factory(self, shard_ids, batch_size, base_key):
+        class _S:
+            sample_wait_ms = 0.0
+
+            def request_iteration(self, wm, beta):
+                pass
+
+            def close(self):
+                pass
+
+        return _S()
+
+
+def _lgroup_measure() -> dict:
+    """In-process arms (devices as the session sees them — ONE on this
+    box): the single learner, the M=1 group (parity), and the M in
+    {2, 4} concat fallback (the same mean-gradient update, counted
+    honestly as fallback_learns)."""
+    from surreal_tpu.parallel.learner_group import LearnerGroup
+
+    learner, state = _lgroup_learner()
+    batch = _lgroup_batch(jax.random.key(1))
+    single = jax.jit(learner.learn, donate_argnums=())
+    single_ups = _lgroup_time_learn(single, state, batch)
+    rows = {}
+    for m in LGROUP_MEMBERS:
+        group = LearnerGroup(
+            learner=learner, plane=_LgroupStubPlane(),
+            batch_size=LGROUP_BATCH, members=m,
+            base_key=jax.random.key(2), single_learn=single,
+        )
+        ups = _lgroup_time_learn(group.learn, state, batch)
+        rows[str(m)] = {
+            "updates_per_s": round(ups, 3),
+            "rows_per_s": round(ups * LGROUP_BATCH, 1),
+            "vs_single": round(ups / single_ups, 4),
+            "allreduce_learns": group.allreduce_learns,
+            "fallback_learns": group.fallback_learns,
+        }
+        group.close()
+    return {
+        "single_updates_per_s": round(single_ups, 3),
+        "parity_ratio": rows["1"]["vs_single"],
+        "members": rows,
+    }
+
+
+_LGROUP_MULTICHIP_SCRIPT = r"""
+import json, os, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+import perf_wallclock as pw
+from surreal_tpu.parallel.learner_group import group_learn
+
+assert jax.device_count() >= 8, jax.device_count()
+learner, state = pw._lgroup_learner()
+batch = pw._lgroup_batch(jax.random.key(1))
+rounds = {}
+base = None
+for m in pw.LGROUP_MEMBERS:
+    mesh = Mesh(np.asarray(jax.devices()[:m]), ("lg",))
+    learn = group_learn(learner, mesh)
+    ups = pw._lgroup_time_learn(learn, state, batch)
+    if base is None:
+        base = ups
+    rounds[str(m)] = {
+        "updates_per_s": round(ups, 3),
+        "speedup_vs_m1": round(ups / base, 4),
+        "devices": m,
+    }
+print(json.dumps({"n_devices": jax.device_count(), "rounds": rounds}))
+"""
+
+
+def _lgroup_multichip(out_path: str) -> dict:
+    """The 8-device CPU-sim round (MULTICHIP_r06.json): the REAL
+    shard_map all-reduce learn at M in {1, 2, 4} simulated members.
+    cores < 2 means the sim devices time-slice one core — recorded as
+    mode='honesty' with the measured (flat or worse) ratios."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _LGROUP_MULTICHIP_SCRIPT],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    tail = (proc.stdout.strip().splitlines() or [""])[-1]
+    cores = os.cpu_count() or 1
+    result = {
+        "n_devices": 8,
+        "rc": proc.returncode,
+        "ok": proc.returncode == 0,
+        "skipped": False,
+        "tail": "" if proc.returncode == 0 else
+                (proc.stderr or proc.stdout)[-2000:],
+        "cores": cores,
+        "mode": "scaling" if cores >= 2 else "honesty",
+        "scale_min_m2": LGROUP_SCALE_MIN_M2,
+    }
+    if proc.returncode == 0:
+        result.update(json.loads(tail))
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2, default=float)
+    return result
+
+
+def learner_group_main(argv) -> int:
+    """--learner-group driver (ISSUE 17): the M=1 parity bound, the
+    per-M learn arms (in-process fallback + 8-device-sim all-reduce),
+    writing ``BENCH_lgroup.json`` and ``MULTICHIP_r06.json`` for
+    ``perf_gate.gate_learner_group`` and PERF.md's scaling table."""
+    import os
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_lgroup.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    mc_path = os.path.join(os.path.dirname(out_path) or ".",
+                           "MULTICHIP_r06.json")
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            row = _lgroup_measure()
+            mc = _lgroup_multichip(mc_path)
+            result = {
+                "metric": "learner_group_m1_parity_ratio",
+                "value": row["parity_ratio"],
+                "unit": "ratio",
+                "geometry": (
+                    f"ddpg learn, batch {LGROUP_BATCH} x obs "
+                    f"{LGROUP_OBS_DIM}, {LGROUP_MEAS} timed updates; "
+                    f"members M in {list(LGROUP_MEMBERS)}"
+                ),
+                "parity_tol": LGROUP_PARITY_TOL,
+                "scale_min_m2": LGROUP_SCALE_MIN_M2,
+                "mode": mc["mode"],
+                "cores": mc["cores"],
+                **row,
+                "multichip": {
+                    k: mc[k] for k in ("ok", "rounds") if k in mc
+                },
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"learner-group attempt {attempt + 1}/{RETRY_ATTEMPTS} "
+                    f"failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -1745,6 +2010,8 @@ def main(argv=None) -> None:
         sys.exit(watchdog_main(argv))
     if "--control" in argv:
         sys.exit(control_main(argv))
+    if "--learner-group" in argv:
+        sys.exit(learner_group_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

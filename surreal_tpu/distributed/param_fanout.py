@@ -223,6 +223,7 @@ class ParameterFanout:
         self.rekeys = 0  # full frames FORCED by a stale/absent ack
         self.bytes_published = 0
         self.last_bytes = 0
+        self._force_full = False  # one-shot: next publish re-keys FULL
 
     def _drain_acks(self) -> None:
         import zmq
@@ -272,6 +273,14 @@ class ParameterFanout:
             # frame must not strand the stream on fetch fallbacks
             want_delta = False
             self.rekeys += 1
+        elif want_delta and self._force_full:
+            # membership re-key (learner group join/leave/rebalance):
+            # the requested full frame is counted as a rekey so the
+            # param/rekeys gauge journals every forced full, whatever
+            # forced it
+            want_delta = False
+            self.rekeys += 1
+        self._force_full = False
         if want_delta:
             frame, shadow = self._codec.encode(
                 self.version, leaves, wire=self.wire,
@@ -301,6 +310,15 @@ class ParameterFanout:
                         "kind": kind, "dropped": True}
         self._pub.send_multipart([TOPIC, frame])
         return {"version": self.version, "bytes": len(frame), "kind": kind}
+
+    def force_rekey(self) -> None:
+        """Make the NEXT publish broadcast a FULL frame (counted into
+        ``param/rekeys``) even when every ack is fresh. Learner-group
+        membership changes call this: after a join/leave/rebalance the
+        one param-distribution tree re-keys so a member that missed
+        deltas during the handoff — or a cold joiner — decodes the next
+        frame without a fetch fallback."""
+        self._force_full = True
 
     # -- pinned-version holds (ISSUE 12: the gateway's version pins) ---------
     def pin_version(self, version: int | None = None) -> int:

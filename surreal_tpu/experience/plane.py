@@ -53,6 +53,7 @@ class ExperiencePlane:
         prefetch: bool = True,
         device_put: bool = True,
         ops_address: str | None = None,
+        build_sampler: bool = True,
     ):
         cfg = dict(cfg or {})
         self.kind = kind
@@ -124,11 +125,10 @@ class ExperiencePlane:
             respawn_backoff_cap_s=self._backoff_cap,
             stop_event=self._stop,
         )
-        self.sampler = ShardedSampler(
-            self.addresses, spec,
-            batch_size=int(batch_size),
+        # remembered so learner-group member samplers (sampler_factory)
+        # inherit the exact same fan-in discipline as the plane's own
+        self._sampler_kw = dict(
             kind=kind,
-            base_key=base_key,
             updates_per_iter=int(updates_per_iter),
             transport=self.transport,
             trace=trace_id,
@@ -139,10 +139,34 @@ class ExperiencePlane:
             device_put=device_put,
             stop_event=self._stop,
         )
+        # build_sampler=False: a learner group drains this plane through
+        # per-member samplers over disjoint address subsets
+        # (parallel/learner_group.py) — the plane-wide sampler would sit
+        # idle, so it is not built at all
+        self.sampler = (
+            ShardedSampler(
+                self.addresses, spec,
+                batch_size=int(batch_size), base_key=base_key,
+                **self._sampler_kw,
+            )
+            if build_sampler else None
+        )
         self._stats_socks: list = [None] * S
         self._stats_cache: list[dict] = [{} for _ in range(S)]
         self._stats_seq = 0
         self._rows_prev: tuple[float, float] | None = None
+
+    def sampler_factory(self, shard_ids, batch_size: int, base_key):
+        """One learner-group member's fan-in: a :class:`ShardedSampler`
+        over the subset of this plane's shard addresses in ``shard_ids``,
+        with the plane's own transport/timeout/backoff/stop discipline.
+        ``batch_size`` is the member's share (``bs_shard * len(shard_ids)``
+        — per-shard draw size is invariant across membership changes)."""
+        return ShardedSampler(
+            [self.addresses[s] for s in shard_ids], self.spec,
+            batch_size=int(batch_size), base_key=base_key,
+            **self._sampler_kw,
+        )
 
     # -- lifecycle -----------------------------------------------------------
     def _spawn_shard(self, i: int):
@@ -274,7 +298,12 @@ class ExperiencePlane:
             "experience/sample_queue_depth": sum(
                 float(s.get("sample_queue_depth", 0)) for s in stats
             ),
-            "experience/sample_wait_ms": float(self.sampler.sample_wait_ms),
+            # group-drained planes (sampler=None) report 0 here; the
+            # per-member wait rides lgroup/sample_wait_ms instead
+            "experience/sample_wait_ms": (
+                float(self.sampler.sample_wait_ms)
+                if self.sampler is not None else 0.0
+            ),
             "experience/dropped_rows": float(self.sender.dropped_rows),
         }
         return out
@@ -296,7 +325,9 @@ class ExperiencePlane:
                 for i, s in enumerate(self._stats_cache) if s
             },
             "sender": self.sender.gauges(),
-            "sampler": self.sampler.gauges(),
+            "sampler": (
+                self.sampler.gauges() if self.sampler is not None else {}
+            ),
             **{
                 k.split("/", 1)[1]: v for k, v in self.gauges(poll=False).items()
                 if k in (
@@ -308,7 +339,8 @@ class ExperiencePlane:
 
     def close(self) -> None:
         self._stop.set()
-        self.sampler.close()
+        if self.sampler is not None:
+            self.sampler.close()
         self.sender.close()
         for w in self.shards:
             if hasattr(w, "terminate"):

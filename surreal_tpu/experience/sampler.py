@@ -67,6 +67,31 @@ class _SampleLink(ShardLinkBase):
             self.prio_sock.close(100)
 
 
+def partition_shards(num_shards: int, members: int) -> list[list[int]]:
+    """Shard-major partition of ``num_shards`` shard indices into
+    ``members`` disjoint, covering, contiguous subsets — the learner
+    group's draining seam (parallel/learner_group.py). Contiguity keeps
+    the group's concatenated batch in GLOBAL shard order (each member's
+    fan-in concatenates its sub-batches in local = global order), so
+    priority routing and lineage columns stay position-stable across
+    membership changes. Earlier members absorb the remainder shards."""
+    if members < 1:
+        raise ValueError(f"learner_group members={members} must be >= 1")
+    if members > num_shards:
+        raise ValueError(
+            f"learner_group members={members} exceeds num_shards="
+            f"{num_shards}: a member with no shard subset would drain "
+            "nothing (shrink the group or add shards)"
+        )
+    base, extra = divmod(num_shards, members)
+    out, start = [], 0
+    for m in range(members):
+        n = base + (1 if m < extra else 0)
+        out.append(list(range(start, start + n)))
+        start += n
+    return out
+
+
 class ShardedSampler:
     def __init__(
         self,

@@ -379,6 +379,17 @@ class SessionHooks:
         self.tracer.event("experience_plane", **info)
         self.ops.push_local("experience", body=info)
 
+    def learner_group_event(self, **info) -> None:
+        """Journal one learner-group membership transition (join/leave/
+        member_failed/respawn/handoff with the shard assignment) as a
+        telemetry ``learner_group`` event — the elastic-membership audit
+        trail the chaos tests and post-mortems read."""
+        self.log.info(
+            "learner group: %s",
+            " ".join(f"{k}={v}" for k, v in sorted(info.items())),
+        )
+        self.tracer.event("learner_group", **info)
+
     def record_program_costs(
         self, name: str, jitted, *args,
         phase: str | None = None, calls_per_phase: int = 1, **kwargs,

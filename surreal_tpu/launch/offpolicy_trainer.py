@@ -921,6 +921,15 @@ class OffPolicyTrainer:
         else:
             host_tail = None
 
+        # elastic data-parallel learner group (parallel/learner_group.py):
+        # topology.learner_group.members > 0 routes draining + learn
+        # through the group — M members over disjoint shard subsets,
+        # gradient all-reduce, one fanout version stream, join/leave
+        # mid-run. Absent config keeps the plane-wide sampler path
+        # untouched.
+        lg_cfg = self.config.session_config.topology.get(
+            "learner_group", None
+        )
         plane = ExperiencePlane(
             kind="prioritized" if self.prioritized else "uniform",
             example=jax.device_get(self._replay_example()),
@@ -940,7 +949,28 @@ class OffPolicyTrainer:
             ),
             base_key=jax.random.fold_in(base_key, 2),
             trace_id=hooks.trace_id,
+            build_sampler=lg_cfg is None,
         )
+        group = None
+        if lg_cfg is not None:
+            from surreal_tpu.parallel.learner_group import LearnerGroup
+
+            group = LearnerGroup(
+                learner=self.learner,
+                plane=plane,
+                batch_size=int(replay_cfg.batch_size),
+                members=int(lg_cfg.get("members", 1)),
+                # the SAME key chain the plane-wide sampler would own —
+                # the 1-member group's record is bit-identical to it
+                base_key=jax.random.fold_in(base_key, 2),
+                single_learn=self._learn,
+                fanout=hooks.fanout,
+                recovery=hooks.recovery,
+                on_event=hooks.learner_group_event,
+                handoff_template=state,
+            )
+            hooks.bind_remediation_actuators(learner_group=group)
+        sampler = group if group is not None else plane.sampler
         recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
         roll = {
             "key": jax.random.fold_in(base_key, 1),
@@ -1009,7 +1039,7 @@ class OffPolicyTrainer:
                 staged = None
                 if pending_jobs:
                     with hooks.tracer.span("sample-wait"):
-                        staged = plane.sampler.get_iteration()
+                        staged = sampler.get_iteration()
                     pending_jobs -= 1
                 if prefetch is not None:
                     with hooks.tracer.span("chunk-wait"):
@@ -1019,7 +1049,7 @@ class OffPolicyTrainer:
                 recent_returns.extend(ep_returns)
                 state = self.learner.update_obs_stats(state, obs_chunk)
                 if sum(wm) >= int(replay_cfg.start_sample_size):
-                    plane.sampler.request_iteration(
+                    sampler.request_iteration(
                         wm, self._beta(env_steps, total)
                     )
                     pending_jobs += 1
@@ -1028,19 +1058,28 @@ class OffPolicyTrainer:
                     infos, tds = [], []
                     for batch, skey, info in staged:
                         with hooks.tracer.span("learn"):
-                            state, metrics = self._learn(state, batch, skey)
-                        hooks.record_program_costs(
-                            "learn", self._learn, state, batch, skey,
-                            phase="learn",
-                        )
+                            if group is not None:
+                                state, metrics = group.learn(
+                                    state, batch, skey
+                                )
+                            else:
+                                state, metrics = self._learn(
+                                    state, batch, skey
+                                )
+                                hooks.record_program_costs(
+                                    "learn", self._learn, state, batch,
+                                    skey, phase="learn",
+                                )
                         td_abs = metrics.pop("priority/td_abs")
                         infos.append(info)
                         tds.append(np.asarray(td_abs))
                     if self.prioritized:
                         # ONE batched priority frame per shard per
                         # iteration (the sample_many discipline on-wire)
-                        plane.sampler.update_priorities(infos, tds)
+                        sampler.update_priorities(infos, tds)
                 plane.supervise()
+                if group is not None:
+                    group.supervise()
                 act_holder[0] = state
                 iteration += 1
                 env_steps += steps_per_iter
@@ -1052,7 +1091,10 @@ class OffPolicyTrainer:
                     # plane.gauges() polls shard stats over the wire —
                     # deferred into the metrics callable so it runs only
                     # when the cadence fires
-                    return dict(base(), **plane.gauges())
+                    row = dict(base(), **plane.gauges())
+                    if group is not None:
+                        row.update(group.gauges())
+                    return row
 
                 m_row, stop = hooks.end_iteration(
                     iteration, env_steps, state, hk_key, build_metrics,
@@ -1083,4 +1125,6 @@ class OffPolicyTrainer:
             plane._stop.set()
             if prefetch is not None:
                 prefetch.close()
+            if group is not None:
+                group.close()
             plane.close()

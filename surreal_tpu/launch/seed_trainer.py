@@ -347,6 +347,45 @@ class SEEDTrainer:
         else:
             # NOT donated — same aliasing as above (see dp_learn's note)
             self._learn = jax.jit(self.learner.learn, donate_argnums=())
+        # learner-group learn program (parallel/learner_group.py): SEED
+        # has no sharded replay plane to partition, so elastic membership
+        # does not apply here — but the group's gradient-all-reduce learn
+        # is the SAME program, so topology.learner_group.members > 1
+        # routes SEED's learn through it when mesh.dp did not already
+        # claim the learn seam. SEED learners carry no per-row TD
+        # bookkeeping; the synthetic priority/td_abs vector group_learn
+        # threads for out-tree stability is popped before metrics ride
+        # the stream.
+        lg = config.session_config.topology.get("learner_group", None)
+        lg_m = int(lg.get("members", 1)) if lg is not None else 1
+        if self.mesh is None and lg_m > 1:
+            from jax.sharding import Mesh
+
+            from surreal_tpu.parallel.learner_group import group_learn
+            from surreal_tpu.parallel.mesh import check_dp_divisible
+
+            check_dp_divisible(
+                config.env_config.num_envs, lg_m, what="env_config.num_envs"
+            )
+            if lg_m > jax.device_count():
+                raise ValueError(
+                    f"topology.learner_group members={lg_m} asks for "
+                    f"{lg_m} devices but only {jax.device_count()} exist"
+                )
+            # batch_dim=1: SEED stages time-major [T, B, ...] chunks —
+            # the group shards the env-batch dim, never the trajectory
+            _group = group_learn(
+                self.learner,
+                Mesh(np.asarray(jax.devices()[:lg_m]), ("lg",)),
+                batch_dim=1,
+            )
+
+            def _lg_learn(state, batch, key):
+                state, metrics = _group(state, batch, key)
+                metrics.pop("priority/td_abs", None)
+                return state, metrics
+
+            self._learn = _lg_learn
 
     def _spawn_one(self, i: int, env_cfg, route, stop):
         """Start env worker ``i`` as a thread or subprocess.

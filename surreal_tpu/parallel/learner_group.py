@@ -1,0 +1,510 @@
+"""Elastic data-parallel learner group over the experience plane (the
+ROADMAP "elastic learner scale-out" item; RollArt-style disaggregation,
+arXiv:2512.22560, with large-batch headroom per arXiv:1803.02811).
+
+M learner members each drain a DISJOINT subset of the plane's shards
+through the PR-8 sampler's shard-major fan-in
+(``experience.sampler.partition_shards`` is the partitioning seam; the
+per-shard draw size ``bs_shard`` is invariant across membership changes,
+so the group's stitched batch is always the full SGD batch in global
+shard order). Gradients all-reduce across the group with the
+``parallel/dp.py`` shard_map machinery — learner state replicated,
+batch sharded on its row dim, ``learner.learn(axis_name=...)`` psums
+grads — so the group trains ONE replicated state published through ONE
+versioned ``ParameterFanout`` tree: agents and the gateway see a single
+version stream regardless of M.
+
+Elastic membership rides the ``RespawnSchedule`` lifecycle: a member
+joining or leaving mid-run costs a shard-subset rebalance + a fanout
+full-frame re-key (``ParameterFanout.force_rekey``), and a cold joiner
+takes its optimizer state from the ``RecoveryManager`` checkpoint walk
+(``restore_newest_finite``) when the journal says "checkpoint", from the
+live replicated state otherwise. A member crash is detected by
+``supervise()`` and respawned under exponential backoff — preemption of
+a learner costs a rebalance, not a run.
+
+On a single device (or when the batch does not tile the member count)
+the all-reduce degrades to ONE full-batch learn — mathematically the
+same update as M mean-reduced gradient shards psummed (mean of shard
+means == full-batch mean), counted in ``lgroup/fallback_learns`` so
+artifacts report the honesty ratio, never a fabricated speedup.
+
+# precision: dtype-transparent by design — the precision policy
+# (ops/precision.py) lives inside learner.learn; shard_map/psum operate
+# on whatever dtypes the learner produces (grads psum in f32 because
+# params are f32 under every policy, the parallel/dp.py rule).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from surreal_tpu.experience.sampler import partition_shards
+from surreal_tpu.replay.sharded import check_group_divisible
+from surreal_tpu.utils import faults
+from surreal_tpu.utils.compat import shard_map
+from surreal_tpu.utils.respawn import RespawnSchedule
+
+
+def _spec_like(tree: Any, spec: P) -> Any:
+    return jax.tree.map(lambda _: spec, tree)
+
+
+def group_learn(learner, mesh: Mesh, axis: str = "lg", batch_dim: int = 0):
+    """Build the group's jitted all-reduce ``learn``: (state, batch, key)
+    -> (state, metrics); state replicated, the batch sharded on its row
+    dim over the member axis, grads psummed inside
+    ``learner.learn(axis_name=...)`` so every member steps to the
+    bitwise-identical successor state.
+
+    ``batch_dim`` names the row dim: 0 for the flat [B, ...] transition
+    batches the elastic group stitches from its members; 1 for the
+    time-major [T, B, ...] trajectory chunks the SEED learn seam stages
+    — sharding dim 0 there would split the V-trace recursion over time
+    (the ``parallel/dp.py`` rule: batches shard on their BATCH dim).
+
+    The per-row ``priority/td_abs`` metric cannot ride the replicated
+    metrics out-spec (each member computes its own rows): it is split
+    out and re-keyed under a sharded out-spec, so the caller still sees
+    the full-batch [B] vector in concatenated (= global shard) order.
+    """
+    batch_spec = P(axis) if batch_dim == 0 else P(None, axis)
+
+    def step(state, batch, key):
+        new_state, metrics = learner.learn(state, batch, key, axis_name=axis)
+        td = metrics.pop("priority/td_abs", None)
+        if td is None:
+            # learner without per-row TD bookkeeping: keep the out-tree
+            # static with a zero vector the caller ignores
+            rows = jax.tree.leaves(batch)[0].shape[batch_dim]
+            td = jnp.zeros((rows,), jnp.float32)
+        return new_state, metrics, td
+
+    def wrapped(state, batch, key):
+        shard = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(
+                _spec_like(state, P()),
+                _spec_like(batch, batch_spec),
+                P(),
+            ),
+            out_specs=(_spec_like(state, P()), P(), P(axis)),
+            check_vma=False,
+        )
+        new_state, metrics, td = shard(state, batch, key)
+        metrics["priority/td_abs"] = td
+        return new_state, metrics
+
+    # donation decision: NOT donated — the host-remote loop's staging
+    # thread keeps acting from the latest state while the next learn
+    # runs (the same aliasing rule as the trainer's single-learner
+    # ``self._learn``), so state-in must stay readable after dispatch
+    return jax.jit(wrapped, donate_argnums=())
+
+
+class _Member:
+    __slots__ = ("id", "slot", "shards", "sampler", "alive", "removed")
+
+    def __init__(self, id: int, slot: int):
+        self.id = id
+        self.slot = slot          # RespawnSchedule slot
+        self.shards: list[int] = []
+        self.sampler = None
+        self.alive = True
+        self.removed = False
+
+
+class LearnerGroup:
+    """M learner members over one experience plane, one replicated train
+    state, one fanout version stream. Duck-types the trainer-facing
+    sampler surface (``request_iteration`` / ``get_iteration`` /
+    ``update_priorities``) plus ``learn`` and the remediation actuator
+    surface (``scale_up`` / ``scale_down``)."""
+
+    # a respawned member that survives this long clears its streak
+    _HEALTHY_S = 10.0
+
+    def __init__(
+        self,
+        *,
+        learner,
+        plane,
+        batch_size: int,
+        members: int = 1,
+        base_key,
+        single_learn: Callable | None = None,
+        fanout=None,
+        recovery=None,
+        on_event: Callable | None = None,
+        handoff_template=None,
+        axis: str = "lg",
+        max_members: int | None = None,
+    ):
+        self.learner = learner
+        self.plane = plane
+        self.axis = axis
+        self.fanout = fanout
+        self.recovery = recovery
+        self._on_event = on_event
+        self._handoff_template = handoff_template
+        self.batch_size = int(batch_size)
+        self.bs_shard = check_group_divisible(
+            self.batch_size, plane.num_shards, int(members)
+        )
+        self.max_members = int(
+            max_members if max_members is not None else plane.num_shards
+        )
+        self._base_key = base_key
+        self._single_learn = single_learn
+        self._learn_cache: dict[int, tuple] = {}
+        self._placed_mesh = None
+        self._sched = RespawnSchedule(
+            int(members), plane._backoff_base, plane._backoff_cap,
+            healthy_s=self._HEALTHY_S,
+        )
+        self._next_id = 0
+        self._epoch = 0  # bumped per rebalance; folds into member keys
+        self.roster: list[_Member] = []
+        for _ in range(int(members)):
+            m = _Member(self._next_id, self._next_id)
+            self._next_id += 1
+            self.roster.append(m)
+        # outstanding iteration jobs (watermarks, beta) in request order:
+        # a member (re)built mid-pipeline re-issues every outstanding job
+        # to its new sampler so get_iteration never blocks on a sampler
+        # that was never asked
+        self._outstanding: deque = deque()
+        self.rebalances = 0
+        self.rekeys = 0
+        self.joins = 0
+        self.leaves = 0
+        self.respawns = 0
+        self.backoff_s = 0.0
+        self.fallback_learns = 0
+        self.allreduce_learns = 0
+        self._assign(initial=True)
+
+    # -- membership ----------------------------------------------------------
+    @property
+    def alive_members(self) -> list[_Member]:
+        return [m for m in self.roster if m.alive]
+
+    @property
+    def members(self) -> int:
+        return len(self.alive_members)
+
+    def _member_key(self, m: _Member):
+        # bit-equality contract: a 1-member group at epoch 0 covering the
+        # whole plane IS the single-sampler path — key used verbatim so
+        # the sampled record matches the plane-wide sampler bit for bit
+        if self._epoch == 0 and len(self.alive_members) == 1:
+            return self._base_key
+        return jax.random.fold_in(
+            jax.random.fold_in(self._base_key, m.id), self._epoch
+        )
+
+    def _assign(self, initial: bool = False, reason: str = "init") -> None:
+        """(Re)partition the plane's shards over the alive members and
+        rebuild the samplers whose subset changed; non-initial calls
+        re-key the fanout and journal a ``learner_group`` event."""
+        alive = self.alive_members
+        if not alive:
+            raise RuntimeError(
+                "learner group has no alive members — leave/fail must "
+                "keep at least one"
+            )
+        subsets = partition_shards(self.plane.num_shards, len(alive))
+        changed = []
+        for m, sub in zip(alive, subsets):
+            if m.sampler is not None and m.shards == sub:
+                continue
+            if m.sampler is not None:
+                m.sampler.close()
+            m.shards = sub
+            m.sampler = self.plane.sampler_factory(
+                sub, self.bs_shard * len(sub), self._member_key(m)
+            )
+            # re-issue every outstanding pipelined job to the new
+            # sampler (sliced to its NEW shard subset) so the next
+            # get_iteration stitches a full batch from the new layout
+            for wm, beta in self._outstanding:
+                m.sampler.request_iteration(
+                    [wm[s] for s in sub] if wm else [], beta
+                )
+            changed.append(m.id)
+        if initial:
+            return
+        self.rebalances += 1
+        if self.fanout is not None:
+            # one param-distribution tree: every membership change
+            # re-keys the stream with a FULL frame
+            self.fanout.force_rekey()
+            self.rekeys += 1
+        self._event(
+            reason,
+            members=len(alive),
+            changed=changed,
+            assignment={str(m.id): m.shards for m in alive},
+        )
+
+    def _event(self, kind: str, **payload) -> None:
+        if self._on_event is not None:
+            self._on_event(op=kind, epoch=self._epoch, **payload)
+
+    def join(self, handoff: str = "auto") -> int:
+        """Add a member mid-run: new RespawnSchedule slot, shard-subset
+        rebalance, fanout full-frame re-key. Optimizer-state handoff for
+        the joiner: the RecoveryManager checkpoint walk
+        (``restore_newest_finite``) when a finite checkpoint exists —
+        journaled as ``handoff='checkpoint'`` with its step — else the
+        live replicated state (``handoff='live'``); in-process members
+        always converge on the live state at the next all-reduce."""
+        if len(self.alive_members) >= self.max_members:
+            raise ValueError(
+                f"learner group is at max_members={self.max_members} "
+                "(one shard subset per member)"
+            )
+        check_group_divisible(
+            self.batch_size, self.plane.num_shards,
+            len(self.alive_members) + 1,
+        )
+        m = _Member(self._next_id, self._sched.add_slot())
+        self._next_id += 1
+        self.roster.append(m)
+        src, step = "live", -1
+        if handoff != "live" and self.recovery is not None \
+                and self._handoff_template is not None:
+            got = self.recovery.restore_newest_finite(self._handoff_template)
+            if got is not None:
+                src, step = "checkpoint", int(got[2])
+        self.joins += 1
+        self._epoch += 1
+        self._assign(reason="join")
+        self._event("handoff", member=m.id, source=src, step=step)
+        return m.id
+
+    def leave(self, member_id: int | None = None) -> int:
+        """Remove a member mid-run (planned scale-down): close its
+        fan-in, rebalance its shard subset onto the survivors, re-key
+        the fanout. The last member cannot leave."""
+        alive = self.alive_members
+        if len(alive) <= 1:
+            raise ValueError("the last learner-group member cannot leave")
+        m = self._find(member_id) if member_id is not None else alive[-1]
+        if not m.alive:
+            raise ValueError(f"member {m.id} is not alive")
+        m.alive = False
+        m.removed = True
+        if m.sampler is not None:
+            m.sampler.close()
+            m.sampler = None
+        self.leaves += 1
+        self._epoch += 1
+        self._assign(reason="leave")
+        return m.id
+
+    def fail_member(self, member_id: int | None = None) -> int:
+        """Simulated crash (chaos surface): the member's fan-in dies
+        without ceremony; survivors absorb its shards NOW and
+        ``supervise()`` respawns it later under backoff."""
+        alive = self.alive_members
+        if len(alive) <= 1:
+            raise ValueError("cannot fail the last learner-group member")
+        m = self._find(member_id) if member_id is not None else alive[-1]
+        m.alive = False
+        if m.sampler is not None:
+            m.sampler.close()
+            m.sampler = None
+        self._epoch += 1
+        self._assign(reason="member_failed")
+        return m.id
+
+    def _find(self, member_id: int) -> _Member:
+        for m in self.roster:
+            if m.id == member_id:
+                return m
+        raise KeyError(f"no learner-group member {member_id}")
+
+    def supervise(self) -> None:
+        """Respawn crashed (not removed) members under the exponential
+        backoff schedule, and fire the ``lgroup.member`` chaos site —
+        the membership analogue of ``ExperiencePlane.supervise``."""
+        f = faults.fire("lgroup.member")
+        if f is not None:
+            kind = f["kind"]
+            if kind == "kill_member" and len(self.alive_members) > 1:
+                self.fail_member(int(f["member"]) if "member" in f else None)
+            elif kind == "join_member" \
+                    and len(self.alive_members) < self.max_members:
+                self.join()
+            elif kind == "leave_member" and len(self.alive_members) > 1:
+                self.leave(int(f["member"]) if "member" in f else None)
+        now = time.monotonic()
+        for m in self.roster:
+            if m.removed:
+                continue
+            if m.alive:
+                self._sched.note_alive(m.slot, now)
+                continue
+            if not self._sched.due(m.slot, now):
+                continue
+            m.alive = True
+            self.respawns += 1
+            self.backoff_s = self._sched.respawned(m.slot, now)
+            self._epoch += 1
+            self._assign(reason="respawn")
+
+    # -- remediation actuator surface (session/remediate.py) -----------------
+    def scale_up(self) -> int:
+        return self.join()
+
+    def scale_down(self, member_id: int | None = None) -> int:
+        return self.leave(member_id)
+
+    # -- trainer-facing sampler surface --------------------------------------
+    def request_iteration(self, watermarks: Sequence[int],
+                          beta: float = 0.0) -> None:
+        wm = list(watermarks)
+        self._outstanding.append((wm, float(beta)))
+        for m in self.alive_members:
+            m.sampler.request_iteration([wm[s] for s in m.shards], beta)
+
+    def get_iteration(self):
+        """Stitch one iteration's batches from every member's fan-in:
+        sub-batches concatenate in roster (= global shard) order, so the
+        group batch is positionally identical to the plane-wide
+        sampler's. Per-member infos + row segments ride the info so
+        priority updates route back to the member that served each
+        segment (a member that left meanwhile just misses its refresh —
+        priorities are a heuristic; the exactly-once invariant lives on
+        the insert wire)."""
+        alive = self.alive_members
+        per_member = [m.sampler.get_iteration() for m in alive]
+        if self._outstanding:
+            self._outstanding.popleft()
+        if len(alive) == 1:
+            # zero-copy parity with the single-sampler path; wrap the
+            # info so update_priorities stays uniform
+            return [
+                (batch, key, {
+                    "member_ids": [alive[0].id],
+                    "segments": [(0, self.batch_size)],
+                    "members": [info],
+                })
+                for batch, key, info in per_member[0]
+            ]
+        out = []
+        for u in range(len(per_member[0])):
+            items = [pm[u] for pm in per_member]
+            batch = jax.tree.map(
+                lambda *xs: jnp.concatenate(xs, axis=0),
+                *[it[0] for it in items],
+            )
+            segments, off = [], 0
+            for m in alive:
+                rows = self.bs_shard * len(m.shards)
+                segments.append((off, rows))
+                off += rows
+            out.append((batch, items[0][1], {
+                "member_ids": [m.id for m in alive],
+                "segments": segments,
+                "members": [it[2] for it in items],
+            }))
+        return out
+
+    def update_priorities(self, infos: Sequence[dict],
+                          prios: Sequence[np.ndarray]) -> None:
+        by_member: dict[int, tuple[list, list]] = {}
+        for info, prio in zip(infos, prios):
+            prio = np.asarray(prio, np.float32)
+            for mid, (off, rows), m_info in zip(
+                info["member_ids"], info["segments"], info["members"]
+            ):
+                by_member.setdefault(mid, ([], []))
+                by_member[mid][0].append(m_info)
+                by_member[mid][1].append(prio[off:off + rows])
+        alive_by_id = {m.id: m for m in self.alive_members}
+        for mid, (m_infos, m_prios) in by_member.items():
+            m = alive_by_id.get(mid)
+            if m is None or m.sampler is None:
+                continue  # served by a member that left/failed meanwhile
+            m.sampler.update_priorities(m_infos, m_prios)
+
+    # -- learn ----------------------------------------------------------------
+    def _single(self) -> Callable:
+        if self._single_learn is None:
+            # donation decision: NOT donated — same staging-thread
+            # aliasing rule as group_learn above
+            self._single_learn = jax.jit(
+                self.learner.learn, donate_argnums=()
+            )
+        return self._single_learn
+
+    def learn(self, state, batch, key):
+        """One SGD update on the full stitched batch. M members on >=M
+        devices run the shard_map all-reduce (per-M program, cached);
+        one device falls back to the single full-batch learn — the same
+        mean-gradient update, counted in ``lgroup/fallback_learns``.
+
+        A membership change changes the learn geometry: the state stays
+        committed to the OLD M's device set, so it is re-placed
+        (replicated) onto the new mesh — one host-roundtrip-free
+        transfer per rebalance, part of the rebalance cost."""
+        M = len(self.alive_members)
+        rows = int(jax.tree.leaves(batch)[0].shape[0])
+        if M > 1 and jax.device_count() >= M and rows % M == 0:
+            got = self._learn_cache.get(M)
+            if got is None:
+                mesh = Mesh(
+                    np.asarray(jax.devices()[:M]), (self.axis,)
+                )
+                got = (group_learn(self.learner, mesh, self.axis), mesh)
+                self._learn_cache[M] = got
+            fn, mesh = got
+            if self._placed_mesh is not mesh:
+                state = jax.device_put(
+                    state, jax.sharding.NamedSharding(mesh, P())
+                )
+                self._placed_mesh = mesh
+            self.allreduce_learns += 1
+            return fn(state, batch, key)
+        if self._placed_mesh is not None:
+            state = jax.device_put(state, jax.devices()[0])
+            self._placed_mesh = None
+        if M > 1:
+            self.fallback_learns += 1
+        return self._single()(state, batch, key)
+
+    # -- gauges / lifecycle ---------------------------------------------------
+    def gauges(self) -> dict[str, float]:
+        alive = self.alive_members
+        waits = [
+            float(m.sampler.sample_wait_ms)
+            for m in alive if m.sampler is not None
+        ]
+        return {
+            "lgroup/members": float(len(alive)),
+            "lgroup/rebalances": float(self.rebalances),
+            "lgroup/rekeys": float(self.rekeys),
+            "lgroup/joins": float(self.joins),
+            "lgroup/leaves": float(self.leaves),
+            "lgroup/respawns": float(self.respawns),
+            "lgroup/respawn_backoff_s": float(self.backoff_s),
+            "lgroup/sample_wait_ms": max(waits) if waits else 0.0,
+            "lgroup/allreduce_learns": float(self.allreduce_learns),
+            "lgroup/fallback_learns": float(self.fallback_learns),
+        }
+
+    def close(self) -> None:
+        for m in self.roster:
+            if m.sampler is not None:
+                m.sampler.close()
+                m.sampler = None

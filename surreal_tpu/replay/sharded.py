@@ -68,6 +68,30 @@ def scale_replay_config(replay_config, dp: int):
     ).extend(replay_config)
 
 
+def check_group_divisible(batch_size: int, num_shards: int,
+                          members: int) -> int:
+    """Geometry rule for the data-parallel learner group
+    (parallel/learner_group.py), the ``scale_replay_config`` discipline
+    applied across group members: the global SGD batch must tile both
+    the shard fan-in (``bs_shard`` rows per shard, invariant across
+    membership changes) and the member all-reduce split (equal
+    per-device rows on the mesh path). Returns ``bs_shard``."""
+    if members < 1:
+        raise ValueError(f"learner_group.members={members} must be >= 1")
+    if batch_size % num_shards:
+        raise ValueError(
+            f"replay.batch_size={batch_size} must be divisible by "
+            f"experience_plane.num_shards={num_shards}"
+        )
+    if batch_size % members:
+        raise ValueError(
+            f"replay.batch_size={batch_size} must be divisible by "
+            f"learner_group.members={members} (equal per-member rows "
+            "on the all-reduce split)"
+        )
+    return batch_size // num_shards
+
+
 def sharded_replay_init(replay, example: Any, mesh: Mesh, axis: str = "dp") -> Any:
     """Allocate one independent buffer shard per device (``replay`` must be
     built with the per-device scaled config).

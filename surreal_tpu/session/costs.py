@@ -285,6 +285,33 @@ GAUGE_REGISTRY = {
         'counted; actuation must never kill training.'),
     "remediation/active": _g("count",
         'actions currently inside their verification window.'),
+    # -- elastic learner group (parallel/learner_group.py; ISSUE 17) --------
+    "lgroup/members": _g("count",
+        'alive data-parallel learner-group members draining the '
+        'experience plane.'),
+    "lgroup/rebalances": _g("count",
+        'shard-subset repartitions (join/leave/failure/respawn each '
+        'costs one rebalance, not a run).'),
+    "lgroup/rekeys": _g("count",
+        'fanout full-frame re-keys forced by membership changes (each '
+        'also counts into param/rekeys on the one distribution tree).'),
+    "lgroup/joins": _g("count", 'members that joined mid-run.'),
+    "lgroup/leaves": _g("count",
+        'members removed mid-run (planned scale-down).'),
+    "lgroup/respawns": _g("count",
+        'crashed members revived under the RespawnSchedule backoff.'),
+    "lgroup/respawn_backoff_s": _g("scalar",
+        'current member-respawn backoff (exponential, capped).'),
+    "lgroup/sample_wait_ms": _g("ms",
+        "slowest member's EWMA batch-stitch wait — the group analogue "
+        'of experience/sample_wait_ms.'),
+    "lgroup/allreduce_learns": _g("count",
+        'SGD updates run through the shard_map gradient all-reduce '
+        '(M members on >= M devices).'),
+    "lgroup/fallback_learns": _g("count",
+        'M>1 updates degraded to ONE full-batch learn (single device / '
+        'indivisible batch) — the honesty counter: artifacts report a '
+        'ratio, never a fabricated speedup.'),
     # -- tenant load generator (gateway/loadgen.py; ISSUE 16) ---------------
     "gateway/quota_changes": _g("count",
         'runtime per-tenant quota mutations via AdmissionController.'

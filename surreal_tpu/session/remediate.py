@@ -17,6 +17,9 @@ ONE bounded action on an actuator the system already has:
                                     (RespawnSchedule-backed)
     learner      learner_downshift  the config overrides path    restore the
     (regression)                    (batch/precision)            prior values
+    learner      learner_scale_up   LearnerGroup.scale_up        scale_down
+    (saturated/                     (parallel/learner_group.py:  (remove the
+    lagging)                        join a member, rebalance)    joined member)
 
 Discipline (the PR-15 false-positive guard, extended to actuation):
 
@@ -99,6 +102,7 @@ class RemediationEngine:
         self._restart: dict = {}
         self._learner_downshift = None
         self._learner_restore = None
+        self._learner_group = None
         # bookkeeping
         self._next_id = 1
         self._active: list[dict] = []   # actions still under verification
@@ -113,14 +117,18 @@ class RemediationEngine:
         self._write_ok = folder is not None
 
     def bind_actuators(self, fleet=None, admission=None, restart=None,
-                       learner_downshift=None, learner_restore=None) -> None:
+                       learner_downshift=None, learner_restore=None,
+                       learner_group=None) -> None:
         """Hand the engine its actuator surfaces: ``fleet`` duck-types
         ``scale_up()/scale_down()`` (InferenceFleet), ``admission``
         duck-types ``quota_of()/set_quota()`` (AdmissionController),
         ``restart`` maps tier name -> zero-arg supervise callable (the
-        RespawnSchedule-backed supervisors), and the learner pair
+        RespawnSchedule-backed supervisors), the learner pair
         implements the overrides downshift (downshift() -> revert
-        payload or None; restore(payload))."""
+        payload or None; restore(payload)), and ``learner_group``
+        duck-types ``scale_up() -> member_id / scale_down(member_id)``
+        (the elastic LearnerGroup — ROADMAP's "scale the named tier"
+        reservation for learners)."""
         if fleet is not None:
             self._fleet = fleet
         if admission is not None:
@@ -131,6 +139,8 @@ class RemediationEngine:
             self._learner_downshift = learner_downshift
         if learner_restore is not None:
             self._learner_restore = learner_restore
+        if learner_group is not None:
+            self._learner_group = learner_group
 
     # -- the per-cadence decision sweep --------------------------------------
     def step(self, firings: list[dict] | None, snap: dict | None) -> None:
@@ -233,6 +243,20 @@ class RemediationEngine:
                 "detail": "batch/precision downshift via config overrides",
                 "objective": "throughput",
             }
+        if tier == "learner" and not regression and (
+            self._learner_group is not None
+        ):
+            # non-regression learner causes (saturation/growth/liveness
+            # naming the learner tier = it can't keep up, not that its
+            # update got slower): add a group member under the same
+            # cooldown + max-actions + counter-detection discipline;
+            # revert = remove the joined member
+            return {
+                "kind": "learner_scale_up",
+                "detail": "join a learner-group member "
+                          "(shard rebalance + fanout re-key)",
+                "objective": "throughput",
+            }
         return None
 
     def _burning_tenant(self, snap: dict) -> tuple[str, str] | None:
@@ -287,6 +311,8 @@ class RemediationEngine:
                     return
                 revert_info = {"payload": payload}
                 reversible = self._learner_restore is not None
+            elif kind == "learner_scale_up":
+                revert_info["member"] = int(self._learner_group.scale_up())
             else:  # pragma: no cover — _map_action emits only the above
                 raise ValueError(f"unknown action kind {kind}")
         except Exception as e:  # noqa: BLE001 — actuation must never
@@ -439,6 +465,8 @@ class RemediationEngine:
                 self._admission.set_quota(info["tenant"], info["quota"])
             elif kind == "learner_downshift":
                 self._learner_restore(info["payload"])
+            elif kind == "learner_scale_up":
+                self._learner_group.scale_down(info.get("member"))
             else:
                 return
         except Exception as e:  # noqa: BLE001 — a failed revert is

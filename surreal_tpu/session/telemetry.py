@@ -207,6 +207,9 @@ EVENT_REGISTRY = {
     "remediation_verdict": "counter-detector verdict on a completed "
                            "verification window (session/remediate.py)",
     "loadgen": "tenant load generator stop summary (gateway/loadgen.py)",
+    "learner_group": "elastic learner-group membership transitions "
+                     "(parallel/learner_group.py via "
+                     "SessionHooks.learner_group_event)",
 }
 
 

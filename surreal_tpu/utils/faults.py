@@ -97,6 +97,16 @@ Sites and the kinds they honor:
                          the sweep — evaluation is host-side and off the
                          jitted step, so a slow sweep must never shift
                          measured iteration time)
+    lgroup.member        once per learner-group supervise pass
+                         (``kill_member``: crash a member — survivors
+                         absorb its shard subset NOW and the group
+                         respawns it under backoff; ``join_member`` /
+                         ``leave_member``: drive a planned mid-run
+                         membership change at a deterministic call
+                         count — the chaos handle for the elastic
+                         join/leave acceptance runs; optional
+                         ``member`` selects the target, default the
+                         last alive member)
     gateway.session      once per gateway serve-loop pass
                          (``drop_frame``: swallow the act reply frame —
                          the client's bounded resend redelivers against
@@ -149,6 +159,7 @@ SITES = frozenset(
         "ops.push",
         "trace.emit",
         "watchdog.eval",
+        "lgroup.member",
     }
 )
 

@@ -113,7 +113,7 @@ _DONATION_SCOPED_SOURCES = (
     # donated — and where accidental donation of an aliased state (the
     # SEED act closure, the overlap collector's acting reference) is a
     # use-after-free. Either way the decision must be explicit.
-    "learners", "parallel/dp.py",
+    "learners", "parallel/dp.py", "parallel/learner_group.py",
     "launch/trainer.py", "launch/offpolicy_trainer.py",
     "launch/seed_trainer.py", "launch/multihost_trainer.py",
 )
@@ -385,7 +385,7 @@ def test_perf_gauges_appear_in_registry():
 
     lit = re.compile(
         r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo"
-        r"|lineage|trace|remediation|loadgen)"
+        r"|lineage|trace|remediation|loadgen|lgroup)"
         r"/[a-z0-9_]+)[\"']"
     )
     bad = []
@@ -401,7 +401,7 @@ def test_perf_gauges_appear_in_registry():
                 )
     assert not bad, (
         "perf/replay/experience/fleet/param/gateway/ops/slo/lineage/trace/"
-        "remediation/loadgen gauges emitted "
+        "remediation/loadgen/lgroup gauges emitted "
         "but not documented in session/costs.py::GAUGE_REGISTRY:\n"
         + "\n".join(bad)
     )
@@ -410,7 +410,7 @@ def test_perf_gauges_appear_in_registry():
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
              "gateway/", "ops/", "slo/", "lineage/", "trace/",
-             "remediation/", "loadgen/")
+             "remediation/", "loadgen/", "lgroup/")
         ), name
 
 

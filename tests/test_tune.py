@@ -408,12 +408,22 @@ def _assert_trees_close(a, b, rtol, atol):
 @pytest.mark.parametrize(
     "variant, rtol, atol",
     [
-        ({"rollout_unroll": 4}, UNROLL_RTOL, UNROLL_ATOL),
-        ({"sgd_unroll": 2}, UNROLL_RTOL, UNROLL_ATOL),
-        ({"gae_unroll": 4}, UNROLL_RTOL, UNROLL_ATOL),
+        # tier-1 keeps the combined unroll variant (exercises all three
+        # unroll knobs in one program) and the pallas impl (the most
+        # distinct codepath); the single-knob variants and the assoc
+        # impl compile the same fused program with the same equivalence
+        # arithmetic and ride the slow tier (ISSUE 17 suite-wall
+        # headroom satellite, same precedent as the ddpg sweep below)
+        pytest.param({"rollout_unroll": 4}, UNROLL_RTOL, UNROLL_ATOL,
+                     marks=pytest.mark.slow),
+        pytest.param({"sgd_unroll": 2}, UNROLL_RTOL, UNROLL_ATOL,
+                     marks=pytest.mark.slow),
+        pytest.param({"gae_unroll": 4}, UNROLL_RTOL, UNROLL_ATOL,
+                     marks=pytest.mark.slow),
         ({"rollout_unroll": 8, "sgd_unroll": 2, "gae_unroll": 2},
          UNROLL_RTOL, UNROLL_ATOL),
-        ({"gae_impl": "assoc"}, IMPL_RTOL, IMPL_ATOL),
+        pytest.param({"gae_impl": "assoc"}, IMPL_RTOL, IMPL_ATOL,
+                     marks=pytest.mark.slow),
         ({"gae_impl": "pallas"}, IMPL_RTOL, IMPL_ATOL),
     ],
     ids=["rollout", "sgd", "gae", "all-unrolls", "assoc", "pallas"],
