@@ -325,6 +325,14 @@ def main() -> int:
         from perf_wallclock import control_main
 
         return control_main(sys.argv[1:])
+    if "--replay-tiers" in sys.argv:
+        # replay-tiers campaign (ISSUE 18): hot-tier sample wait vs the
+        # warm shard fan-in, WAL append bytes/step, quantized vs raw
+        # cold bytes/transition — writes BENCH_tiers.json (perf_gate's
+        # replay-tiers gate consumes it)
+        from perf_wallclock import replay_tiers_main
+
+        return replay_tiers_main(sys.argv[1:])
     if "--learner-group" in sys.argv:
         # elastic learner-group campaign (ISSUE 17): M=1 parity vs the
         # single learner, per-M learn arms (in-process fallback + the

@@ -651,6 +651,63 @@ def gate_learner_group(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_replay_tiers(art_dir: str, out=sys.stdout) -> int:
+    """Replay-tiers gate (ISSUE 18): when a committed
+    ``BENCH_tiers.json`` exists (``bench.py --replay-tiers``), enforce
+    the hierarchy's two commitments on the image it was measured on:
+
+    - the hot arm's learner sample-wait EWMA sits at or below the warm
+      arm's — the device-resident tier must never be slower to serve
+      than the shard fan-in it fronts (the acceptance criterion: hot-hit
+      ``experience/sample_wait_ms`` measurably below the committed warm
+      figure);
+    - the quantized cold row is >= 25% smaller than the raw f32
+      transition (``cold_vs_raw_ratio <= 0.75``) — the HEPPO-GAE
+      quantization actually pays for itself on disk.
+
+    rc 0 with a note when the artifact is absent or from a failed round
+    (a missing campaign is not a regression).
+    """
+    path = os.path.join(art_dir, "BENCH_tiers.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_tiers.json — replay tiers not measured "
+              "(rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("value") is None:
+        print("perf_gate: BENCH_tiers.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    warm_wait = (data.get("warm") or {}).get("sample_wait_ms")
+    hot_wait = (data.get("hot") or {}).get("sample_wait_ms")
+    if warm_wait is not None and hot_wait is not None:
+        line = (
+            f"perf_gate: replay-tiers hot sample-wait "
+            f"{float(hot_wait):.3f} ms vs warm {float(warm_wait):.3f} ms "
+            "(commitment: hot <= warm)"
+        )
+        if float(hot_wait) > float(warm_wait):
+            print(line + " — HOT TIER SLOWER THAN WARM", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    ratio = data.get("cold_vs_raw_ratio")
+    if ratio is not None:
+        line = (
+            f"perf_gate: replay-tiers cold row {float(ratio):.3f}x the raw "
+            "f32 transition (commitment <= 0.75)"
+        )
+        if float(ratio) > 0.75:
+            print(line + " — QUANTIZATION NOT PAYING", file=out)
+            rc = 1
+        else:
+            print(line + " — ok", file=out)
+    return rc
+
+
 def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
     committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
@@ -720,7 +777,7 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
         gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
         gate_trace(art_dir, out=out), gate_watchdog(art_dir, out=out),
         gate_control(art_dir, out=out), gate_learner_group(art_dir, out=out),
-        gate_tier1(art_dir, out=out),
+        gate_replay_tiers(art_dir, out=out), gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

@@ -1662,6 +1662,105 @@ def _load_tune_bench():
     return data
 
 
+def _load_tiers_bench():
+    """Load the replay-tiers artifact (``BENCH_tiers.json``, written by
+    ``bench.py --replay-tiers``) if present — the BENCH_host.json
+    discipline: PERF.md regens preserve the measured section without
+    re-running."""
+    try:
+        with open("BENCH_tiers.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or data.get("value") is None:
+        return None  # failed-campaign artifact
+    return data
+
+
+def _replay_tiers_lines() -> list[str]:
+    """The 'Hierarchical replay tiers' PERF.md section: static mechanism
+    text plus the measured warm-vs-hot table from the BENCH_tiers.json
+    artifact. One function so ``main()`` and the committed PERF.md
+    cannot drift."""
+    lines = [
+        "",
+        "## Hierarchical replay tiers (device-resident hot ring, "
+        "quantized spill WAL)",
+        "",
+        "The replay hierarchy (ISSUE 18): `replay.tiers.hot` fronts the "
+        "PR-8 shard fan-in with a fixed-capacity ring of the NEWEST "
+        "transitions held as committed device arrays "
+        "(`replay/tiers.py`), filled from the collector's "
+        "still-device-resident n-step fold and drawn by the same "
+        "`jax.random.randint` + `ring_gather` as the in-process "
+        "`UniformReplay` (BIT-EQUAL for the same keys — tested; the "
+        "PR-7 Pallas row-DMA kernel carries the gather on TPU), so a "
+        "steady-state uniform sample never touches the host: no wire "
+        "frame, no `spec.unpack`, no host->device transfer. Misses "
+        "while the ring fills fall back to the warm shard fan-in with "
+        "the SAME key chain — counted in `tier/hot_misses`, never "
+        "silent. `replay.tiers.spill` turns shard ingest into a durable "
+        "write-ahead log (`experience/spill.py`): length-framed, "
+        "CRC-checked segments in global `(seq, shard)` order, cold "
+        "rewards/values quantized to uint8 against per-segment ranges "
+        "(HEPPO-GAE, arXiv:2501.12703) with the error bound recorded in "
+        "the header, other f32 columns as f16. "
+        "`OffPolicyTrainer.replay_from_log` replays the WAL into a "
+        "fresh ring and reruns the update schedule — two passes are "
+        "bit-identical (tested), and torn tail segments (crash "
+        "mid-append; the `experience.spill` chaos site) are skipped by "
+        "magic-resync and counted in `tier/torn_segments`. Tiers off is "
+        "bit-identical to the untiered plane (tested).",
+    ]
+    tb = _load_tiers_bench()
+    if tb:
+        warm, hot = tb.get("warm") or {}, tb.get("hot") or {}
+        lines += [
+            "",
+            f"Measured through the real off-policy trainer "
+            f"({tb['geometry']}; `BENCH_tiers.json`, platform "
+            f"`{tb.get('platform')}`; warm iterations discarded):",
+            "",
+            "| Arm | env steps/s | iter ms | learner sample-wait ms | "
+            "wire B/step |",
+            "|---|---|---|---|---|",
+        ]
+        for r in (warm, hot):
+            lines.append(
+                "| {a} | {s:,.0f} | {ms:.1f} | {sw:.3f} | {w:.2f} |".format(
+                    a=r.get("arm"),
+                    s=float(r.get("env_steps_per_s", 0)),
+                    ms=float(r.get("iter_ms", 0)),
+                    sw=float(r.get("sample_wait_ms", 0)),
+                    w=float(r.get("wire_bytes_per_step", 0)),
+                )
+            )
+        lines += [
+            "",
+            "The hot arm served {hits:,.0f}/{tot:,.0f} updates from the "
+            "device ring (sample-wait {hw:.3f} ms vs the warm arm's "
+            "{ww:.2f} ms — the draw dispatches on-device at request "
+            "time and overlaps the learner), while the spill WAL "
+            "appended {wal:.1f} B/env-step at {cold:.0f} B/transition "
+            "against the {raw} B raw f32 row ({ratio:.2f}x, gate "
+            "commits <= 0.75). One-core honesty: both arms share one "
+            "CPU core with the shard servers, so arm-to-arm steps/s "
+            "differences are contention-dominated; the committed wins "
+            "are the sample path and the cold bytes.".format(
+                hits=float(tb.get("hot_hits") or 0),
+                tot=float(tb.get("hot_hits") or 0)
+                + float(tb.get("hot_misses") or 0),
+                hw=float(hot.get("sample_wait_ms") or 0),
+                ww=float(warm.get("sample_wait_ms") or 0),
+                wal=float(tb.get("wal_bytes_per_step") or 0),
+                cold=float(tb.get("cold_bytes_per_transition") or 0),
+                raw=tb.get("raw_bytes_per_transition"),
+                ratio=float(tb.get("cold_vs_raw_ratio") or 0),
+            ),
+        ]
+    return lines
+
+
 def _autotuner_lines() -> list[str]:
     """The 'Program autotuner' PERF.md section: static mechanism text plus
     the measured table from the BENCH_tune.json artifact when one exists.
@@ -2295,6 +2394,7 @@ def main(argv=None) -> None:
     lines += _trace_lines()
     lines += _watchdog_lines()
     lines += _control_lines()
+    lines += _replay_tiers_lines()
     if scaling:
         lines += [
             "",

@@ -311,7 +311,8 @@ XP_WARM = 4
 XP_MEAS = 16
 
 
-def _xp_trainer(kind: str, transport: str, folder: str, seed: int = 0):
+def _xp_trainer(kind: str, transport: str, folder: str, seed: int = 0,
+                tiers=None):
     from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
     from surreal_tpu.session.config import Config
     from surreal_tpu.session.default_configs import base_config
@@ -321,6 +322,8 @@ def _xp_trainer(kind: str, transport: str, folder: str, seed: int = 0):
         remote_kind="uniform",
         capacity=16_384, start_sample_size=512, batch_size=XP_BATCH,
     )
+    if tiers is not None:
+        replay.tiers = Config(tiers)
     cfg = Config(
         learner_config=Config(
             algo=Config(
@@ -349,7 +352,7 @@ def _xp_trainer(kind: str, transport: str, folder: str, seed: int = 0):
     return OffPolicyTrainer(cfg)
 
 
-def _xp_measure(kind: str, transport: str) -> dict:
+def _xp_measure(kind: str, transport: str, tiers=None, arm=None) -> dict:
     """One off-policy run (remote plane arm, or the in-process reference)
     at the local-shards geometry; warm iterations discarded. Records the
     settled experience gauges and the fixed-seed reward trajectory so the
@@ -358,7 +361,7 @@ def _xp_measure(kind: str, transport: str) -> dict:
     import tempfile
 
     folder = tempfile.mkdtemp(prefix="bench_xp_")
-    trainer = _xp_trainer(kind, transport, folder)
+    trainer = _xp_trainer(kind, transport, folder, tiers=tiers)
     marks: list[tuple[float, float]] = []
     returns: list = []
     last: dict = {}
@@ -379,7 +382,7 @@ def _xp_measure(kind: str, transport: str) -> dict:
     t1, s1 = marks[-1]
     n = len(marks) - XP_WARM
     row = {
-        "arm": kind if kind != "remote" else f"remote-{transport}",
+        "arm": arm or (kind if kind != "remote" else f"remote-{transport}"),
         "env_steps_per_s": round((s1 - s0) / (t1 - t0), 1),
         "iter_ms": round((t1 - t0) / n * 1e3, 2),
         "episode_returns": returns,
@@ -394,6 +397,10 @@ def _xp_measure(kind: str, transport: str) -> dict:
             "dropped_rows": last.get("experience/dropped_rows"),
             "respawns": last.get("experience/respawns"),
         })
+        tier = {k: v for k, v in last.items() if k.startswith("tier/")}
+        if tier:
+            row["tiers"] = tier
+            row["env_steps"] = last.get("time/env_steps")
     return row
 
 
@@ -458,6 +465,122 @@ def experience_plane_main(argv) -> int:
                 wait = RETRY_BACKOFF_S * 2**attempt
                 print(
                     f"experience-plane attempt {attempt + 1}/{RETRY_ATTEMPTS}"
+                    f" failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
+# -- replay tiers (--replay-tiers) --------------------------------------------
+
+def replay_tiers_main(argv) -> int:
+    """--replay-tiers driver (ISSUE 18): the hierarchical-replay
+    acceptance artifact. Two arms at the --experience-plane geometry
+    (shm transport, 2 local thread shards):
+
+      warm  replay.tiers absent — every update batch rides the PR-8
+            shard fan-in (wire frame + spec.unpack + host->device put)
+      hot   tiers on — steady-state batches drawn ON DEVICE from the
+            hot ring at request time; the shards become the warm
+            fallback and the spill WAL runs alongside ingest
+
+    Committed figures: both arms' settled experience/sample_wait_ms
+    (the acceptance criterion: hot below warm), the WAL's append
+    bytes/env-step, and quantized vs raw cold bytes/transition.
+
+    One-core honesty: on a single-core CPU box the hot arm's THROUGHPUT
+    need not win — the same core still pays rollout + ingest + WAL
+    encode; what the device-resident tier removes is the learner-side
+    sample path (wait + transfer), which is exactly what sample_wait_ms
+    isolates. The artifact records env_steps/s for both arms unmassaged.
+    """
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_tiers.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    try:
+        import gymnasium  # noqa: F401
+    except Exception as e:
+        result = {"error": f"gymnasium unavailable: {e}", "parsed": None}
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps(result))
+        return 0
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            warm = _xp_measure("remote", "shm", arm="warm")
+            hot = _xp_measure(
+                "remote", "shm",
+                tiers={
+                    "hot": {"enabled": True, "capacity": 4096},
+                    "spill": {"enabled": True},
+                },
+                arm="hot",
+            )
+            tiers = hot.get("tiers", {})
+            steps = float(hot.get("env_steps") or 1)
+            # raw f32 row of the Pendulum transition spec — the
+            # quantization denominator (obs 3 + next_obs 3 + action 1 +
+            # reward 1 + discount 1 floats)
+            raw_row = 9 * 4
+            cold_row = tiers.get("tier/cold_bytes_per_row")
+            result = {
+                "metric": "replay_tiers_hot_sample_wait_ms",
+                "value": hot.get("sample_wait_ms"),
+                "unit": "ms",
+                "geometry": (
+                    f"{XP_NUM_ENVS} gym:Pendulum-v1 envs x {XP_HORIZON} "
+                    f"horizon x {XP_UPDATES} updates/iter (batch "
+                    f"{XP_BATCH}) over {XP_SHARDS} local thread shards, "
+                    "shm transport; hot ring 4096"
+                ),
+                "warm": warm,
+                "hot": hot,
+                "hot_hits": tiers.get("tier/hot_hits"),
+                "hot_misses": tiers.get("tier/hot_misses"),
+                "wal_bytes_per_step": (
+                    round(float(tiers.get("tier/spill_bytes", 0)) / steps, 2)
+                ),
+                "raw_bytes_per_transition": raw_row,
+                "cold_bytes_per_transition": cold_row,
+                "cold_vs_raw_ratio": (
+                    round(float(cold_row) / raw_row, 3)
+                    if cold_row else None
+                ),
+                "torn_segments": tiers.get("tier/torn_segments", 0),
+                "notes": (
+                    "one-core honesty: throughput parity expected on a "
+                    "shared-core CPU box; the committed win is the "
+                    "learner-side sample wait (hot draw dispatches "
+                    "on-device at request time) and the quantized cold "
+                    "row. Wait figures are settled EWMAs from the final "
+                    "metrics row of each arm."
+                ),
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"replay-tiers attempt {attempt + 1}/{RETRY_ATTEMPTS}"
                     f" failed ({err}); retrying in {wait:.0f}s",
                     file=sys.stderr,
                 )

@@ -54,8 +54,16 @@ class ExperiencePlane:
         device_put: bool = True,
         ops_address: str | None = None,
         build_sampler: bool = True,
+        tiers: Mapping[str, Any] | None = None,
     ):
         cfg = dict(cfg or {})
+        # replay tiers (ISSUE 18): the plane owns the spill sub-config
+        # (forwarded to every shard through _shard_cfg, respawns
+        # included) and the tier gauge aggregation; the hot tier itself
+        # is learner-side (TieredSampler, attached via attach_tiers).
+        # tiers=None keeps _shard_cfg byte-identical to the pre-tier
+        # plane — the tiers-off bit-identical contract.
+        self.tiers_cfg = dict(tiers or {})
         self.kind = kind
         self.num_shards = max(1, int(cfg.get("num_shards", 2)))
         self.shard_mode = cfg.get("shard_mode", "thread")
@@ -92,6 +100,9 @@ class ExperiencePlane:
             "watermark_timeout_s": float(cfg.get("watermark_timeout_s", 5.0)),
             "fifo_depth": int(cfg.get("fifo_depth", 64)),
         }
+        spill_cfg = dict(self.tiers_cfg.get("spill") or {})
+        if spill_cfg.get("enabled"):
+            self._shard_cfg["spill"] = spill_cfg
         self.addresses = [_alloc_address() for _ in range(S)]
         self._stop = threading.Event()
         self._fault_plan_sent: set[int] = set()
@@ -155,6 +166,14 @@ class ExperiencePlane:
         self._stats_cache: list[dict] = [{} for _ in range(S)]
         self._stats_seq = 0
         self._rows_prev: tuple[float, float] | None = None
+
+    def attach_tiers(self, tiered) -> None:
+        """Swap the plane's sampler for its hot-tier wrapper
+        (``experience/sampler.py::TieredSampler`` over this plane's own
+        warm sampler): ``gauges()``/``telemetry_event()``/``close()``
+        then see the tiered view — ``experience/sample_wait_ms`` becomes
+        the hot-hit wait, and the ``tier/*`` family lights up."""
+        self.sampler = tiered
 
     def sampler_factory(self, shard_ids, batch_size: int, base_key):
         """One learner-group member's fan-in: a :class:`ShardedSampler`
@@ -306,13 +325,56 @@ class ExperiencePlane:
             ),
             "experience/dropped_rows": float(self.sender.dropped_rows),
         }
+        # tier/* family (registered in session/costs.py): only emitted
+        # when a tier is live, so tiers-off metrics rows are unchanged
+        hot = getattr(self.sampler, "hot", None)
+        if hot is not None:
+            out.update(hot.gauges())
+            out["tier/hot_hits"] = float(self.sampler.hot_hits)
+            out["tier/hot_misses"] = float(self.sampler.hot_misses)
+        spills = [s for s in stats if s and "spill_segments" in s]
+        if spills:
+            for k in ("spill_segments", "spill_rows", "spill_bytes",
+                      "spill_errors", "spill_failed"):
+                out[f"tier/{k}"] = sum(float(s.get(k, 0)) for s in spills)
+            out["tier/cold_bytes_per_row"] = float(np.mean(
+                [float(s.get("cold_bytes_per_row", 0.0)) for s in spills]
+            ))
         return out
+
+    def tier_table(self) -> dict:
+        """Per-shard tier table (rides the ops plane / telemetry event):
+        each shard's warm fill next to its spill-tier progress, plus the
+        learner-side hot tier — the one view that shows where every
+        transition currently lives."""
+        hot = getattr(self.sampler, "hot", None)
+        shards = {}
+        for i, s in enumerate(self._stats_cache):
+            if not s:
+                continue
+            shards[str(i)] = {
+                "warm_size": s.get("size", 0),
+                "warm_fill": s.get("fill", 0.0),
+                **{
+                    k: v for k, v in s.items()
+                    if k.startswith("spill_") or k == "cold_bytes_per_row"
+                },
+            }
+        return {
+            "hot": (
+                dict(hot.gauges(), hits=self.sampler.hot_hits,
+                     misses=self.sampler.hot_misses)
+                if hot is not None else None
+            ),
+            "shards": shards,
+        }
 
     def telemetry_event(self) -> dict:
         """The ``experience_plane`` telemetry event body: per-shard
         snapshots (the per-shard replay/* gauges diag renders) + the
-        sender/sampler hop view."""
+        sender/sampler hop view + the tier table."""
         return {
+            "tiers": self.tier_table(),
             "kind": self.kind,
             "num_shards": self.num_shards,
             "shard_mode": self.shard_mode,

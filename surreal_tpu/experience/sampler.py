@@ -453,11 +453,13 @@ class ShardedSampler:
                     return None
         if job is None:
             return None
-        watermarks, beta = job
-        keys = []
-        for _ in range(self.updates_per_iter):
-            self._key, sub = jax.random.split(self._key)
-            keys.append(sub)
+        watermarks, beta, keys = job
+        if keys is None:
+            # the sampler owns the key chain (the tiers-off default)
+            keys = []
+            for _ in range(self.updates_per_iter):
+                self._key, sub = jax.random.split(self._key)
+                keys.append(sub)
         fetched = self._fetch_iteration(keys, beta, watermarks)
         out = []
         for key, (batch, info) in zip(keys, fetched):
@@ -468,8 +470,12 @@ class ShardedSampler:
 
     # -- iteration API (trainer thread) --------------------------------------
     def request_iteration(self, watermarks: Sequence[int],
-                          beta: float = 0.0) -> None:
-        self._jobs.put((list(watermarks), float(beta)))
+                          beta: float = 0.0, keys=None) -> None:
+        """``keys`` (one per update) lets a tier wrapper own the key
+        chain — the warm fall-back then draws the EXACT keys a hot hit
+        would have used. None keeps this sampler's own chain, byte-for-
+        byte the pre-tiers behavior."""
+        self._jobs.put((list(watermarks), float(beta), keys))
 
     def get_iteration(self):
         t0 = time.perf_counter()
@@ -603,3 +609,107 @@ class ShardedSampler:
             self._prefetch.close()
         for link in self.links:
             link.close()
+
+
+class TieredSampler:
+    """Hot-tier front of the shard fan-in (replay tiers, ISSUE 18).
+
+    Wraps the warm :class:`ShardedSampler` with a device-resident
+    :class:`surreal_tpu.replay.tiers.HotTier`: while the hot ring is
+    warm enough (``ready()``), an iteration's uniform batches are drawn
+    ON DEVICE at *request* time — the jitted draw+gather dispatches
+    async and overlaps the learner, so ``get_iteration`` returns already-
+    resident batches with ~zero wait (the mechanism behind the hot-hit
+    ``experience/sample_wait_ms`` figure in BENCH_tiers.json). A miss —
+    hot ring still filling — falls back to the PR-8 shard-major fan-in
+    with the SAME keys, counted in ``tier/hot_misses``, never silent.
+
+    This wrapper owns the key chain the warm sampler otherwise owns (one
+    split per update, handed down through ``request_iteration(keys=)``),
+    so hot hits and warm misses consume the same key sequence the
+    tiers-off path would.
+
+    Uniform-only by construction: prioritized sampling needs the shard's
+    priority state between draws, which a device-resident snapshot
+    cannot see — the constructor refuses rather than skewing silently.
+    """
+
+    def __init__(self, warm: ShardedSampler, hot, base_key=None):
+        if warm.prioritized:
+            raise ValueError(
+                "replay.tiers.hot requires uniform replay: prioritized "
+                "draws depend on the shards' live priority state"
+            )
+        if warm.kind == "fifo":
+            raise ValueError("replay.tiers.hot does not apply to the fifo arm")
+        from collections import deque
+
+        self._warm = warm
+        self.hot = hot
+        # adopt the warm sampler's UNSPLIT chain (it never splits again —
+        # every request hands keys down): update u draws the exact key
+        # the tiers-off sampler would draw, hot hit or warm miss alike
+        self._key = base_key if base_key is not None else warm._key
+        self.updates_per_iter = warm.updates_per_iter
+        self.batch_size = warm.batch_size
+        self.prioritized = False
+        self.kind = warm.kind
+        # per pending iteration: ("hot", [(device batch, key), ...]) or
+        # ("warm", None) — FIFO with request/get, like the job queue
+        self._route: "deque[tuple[str, list | None]]" = deque()
+        self.hot_hits = 0
+        self.hot_misses = 0
+        self.sample_wait_ms = 0.0
+
+    def append(self, rows) -> None:
+        """Feed the hot ring (flat [n, ...] arrays — the collector's
+        device-resident transition batch, before any host hop)."""
+        self.hot.append(rows)
+
+    def request_iteration(self, watermarks: Sequence[int],
+                          beta: float = 0.0) -> None:
+        import jax
+
+        keys = []
+        for _ in range(self.updates_per_iter):
+            self._key, sub = jax.random.split(self._key)
+            keys.append(sub)
+        if self.hot.ready():
+            # dispatch the draws NOW: async device work overlaps the
+            # learner exactly like the warm prefetch thread would
+            staged = [(self.hot.sample(k), k) for k in keys]
+            self._route.append(("hot", staged))
+            self.hot_hits += self.updates_per_iter
+        else:
+            self.hot_misses += self.updates_per_iter
+            self._warm.request_iteration(watermarks, beta, keys=keys)
+            self._route.append(("warm", None))
+
+    def get_iteration(self):
+        t0 = time.perf_counter()
+        if not self._route:
+            return None
+        src, staged = self._route.popleft()
+        if src == "hot":
+            out = [
+                (wire.unflatten_fields(batch), key, {"tier": "hot"})
+                for batch, key in staged
+            ]
+        else:
+            out = self._warm.get_iteration()
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        self.sample_wait_ms = 0.2 * wait_ms + 0.8 * self.sample_wait_ms
+        return out
+
+    def update_priorities(self, infos, prios) -> None:
+        self._warm.update_priorities(infos, prios)
+
+    def gauges(self) -> dict[str, float]:
+        g = self._warm.gauges()
+        g["sample_wait_ms"] = float(self.sample_wait_ms)
+        g["hot_hits"] = float(self.hot_hits)
+        g["hot_misses"] = float(self.hot_misses)
+        return g
+
+    def close(self) -> None:
+        self._warm.close()

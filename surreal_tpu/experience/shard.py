@@ -318,6 +318,11 @@ def run_shard_server(
     kind = cfg.get("kind", "uniform")
     watermark_timeout_s = float(cfg.get("watermark_timeout_s", 5.0))
     ring = build_ring(cfg, None) if kind == "fifo" else None
+    # disk spill tier (ISSUE 18): every ingested insert also appends to a
+    # per-shard write-ahead-log segment file; created with the ring at
+    # the first sender hello (the spec arrives there). Ring kinds only —
+    # fifo chunks carry per-chunk specs, the WAL frames one spec per log.
+    spill_writer = None
 
     ctx = zmq.Context.instance()
     sock = ctx.socket(zmq.ROUTER)
@@ -341,7 +346,7 @@ def run_shard_server(
         sock.send_multipart([ident, payload])
 
     def grant(ident: bytes, info: dict) -> None:
-        nonlocal ring
+        nonlocal ring, spill_writer
         peer = peers.setdefault(ident, _Peer())
         if peer.applied:
             # re-hello compaction: a sender only re-helloes after clearing
@@ -373,6 +378,12 @@ def run_shard_server(
         peer.spec = spec
         if ring is None and spec is not None and kind != "fifo":
             ring = build_ring(cfg, spec)
+        if spill_writer is None and spec is not None and kind != "fifo":
+            from surreal_tpu.experience import spill
+
+            spill_writer = spill.build_writer(
+                cfg.get("spill"), spec, shard_id
+            )
         requested = info.get("transport", "tcp")
         if requested == "pickle":
             peer.transport = "pickle"
@@ -438,6 +449,12 @@ def run_shard_server(
             ring.insert(peer.spec, rows, n)
         else:
             ring.insert(rows, n)
+        if spill_writer is not None:
+            # WAL append AFTER the ring: the warm tier is the availability
+            # tier — a failing disk degrades (counted) without stalling
+            # ingest. Rows may view a transient frame/slab slot; the
+            # writer's codec copies during encode.
+            spill_writer.append(rows, n)
         peer.mark_applied(seq)
         ingested_rows += n
         now = time.monotonic()
@@ -550,6 +567,8 @@ def run_shard_server(
             out["sample_queue_depth"] = len(deferred)
             if ring is not None:
                 out.update(ring.gauges())
+            if spill_writer is not None:
+                out.update(spill_writer.stats())
             from surreal_tpu.session.telemetry import latency_percentiles
 
             p = latency_percentiles(transit_ms)
@@ -618,6 +637,8 @@ def run_shard_server(
         gauges["sample_queue_depth"] = len(deferred)
         if ring is not None:
             gauges.update(ring.gauges())
+        if spill_writer is not None:
+            gauges.update(spill_writer.stats())
         from surreal_tpu.session.telemetry import latency_percentiles
 
         p = latency_percentiles(transit_ms)
@@ -656,6 +677,8 @@ def run_shard_server(
         # unlink_slab tolerates (ENOENT is a no-op).
         if ops is not None:
             ops.close()
+        if spill_writer is not None:
+            spill_writer.close()
         graceful = stop_event is not None and stop_event.is_set()
         for peer in peers.values():
             peer.views = []

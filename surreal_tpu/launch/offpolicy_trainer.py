@@ -896,6 +896,26 @@ class OffPolicyTrainer:
         steps_per_iter = self.horizon * self.num_envs
         act_dim = int(self.env.specs.action.shape[0])
         replay_cfg = self.learner.config.replay
+        # replay tiers (ISSUE 18): `replay.tiers.hot` fronts the plane
+        # with a device-resident ring, `replay.tiers.spill` turns the
+        # shards' ingest into a durable WAL. tiers absent => tiers_cfg
+        # None => the plane build below is byte-identical to today.
+        tiers_cfg = replay_cfg.get("tiers", None)
+        if tiers_cfg is not None:
+            tiers_cfg = (
+                tiers_cfg.to_dict()
+                if hasattr(tiers_cfg, "to_dict") else dict(tiers_cfg)
+            )
+            spill_cfg = dict(tiers_cfg.get("spill") or {})
+            if spill_cfg.get("enabled") and not spill_cfg.get("dir"):
+                import os
+
+                # default spill dir under the session folder, next to
+                # telemetry/checkpoints — `replay_from_log` finds it there
+                spill_cfg["dir"] = os.path.join(
+                    self.config.session_config.folder, "spill"
+                )
+                tiers_cfg["spill"] = spill_cfg
         ckpt_cfg = self.config.session_config.checkpoint
         if ckpt_cfg.get("include_replay", False):
             hooks.log.warning(
@@ -950,7 +970,37 @@ class OffPolicyTrainer:
             base_key=jax.random.fold_in(base_key, 2),
             trace_id=hooks.trace_id,
             build_sampler=lg_cfg is None,
+            tiers=tiers_cfg,
         )
+        # hot tier: device-resident newest-transition ring fronting the
+        # shard fan-in (replay/tiers.py). Uniform + plane-wide sampler
+        # only — the learner group partitions shards across members and
+        # prioritized draws need live shard priority state.
+        tiered = None
+        hot_cfg = dict((tiers_cfg or {}).get("hot") or {})
+        if hot_cfg.get("enabled"):
+            if lg_cfg is not None or self.prioritized:
+                hooks.log.warning(
+                    "replay.tiers.hot ignored: requires uniform replay "
+                    "and no learner group"
+                )
+            else:
+                from surreal_tpu.experience.sampler import TieredSampler
+                from surreal_tpu.replay.tiers import HotTier
+
+                hot = HotTier(
+                    capacity=int(
+                        hot_cfg.get("capacity", replay_cfg.capacity)
+                    ),
+                    batch_size=int(replay_cfg.batch_size),
+                    gather_impl=hot_cfg.get("gather_impl"),
+                    min_fill=hot_cfg.get("min_fill"),
+                    # storage in the WARM example's staging dtypes: a hot
+                    # sample is dtype-identical to a warm fan-in batch
+                    example=self._replay_example(),
+                )
+                tiered = TieredSampler(plane.sampler, hot)
+                plane.attach_tiers(tiered)
         group = None
         if lg_cfg is not None:
             from surreal_tpu.parallel.learner_group import LearnerGroup
@@ -1013,6 +1063,11 @@ class OffPolicyTrainer:
                 wm = plane.sender.send_rows(
                     jax.device_get(trans), row_slots
                 )
+            if tiered is not None:
+                # hot tier eats the SAME flat rows the shards just got,
+                # but from the fold's still-device-resident output — the
+                # append is a jitted ring insert, no host round trip
+                tiered.append(dict(trans))
             return wm, traj["obs"], chunk_returns
 
         overlap = bool(
@@ -1128,3 +1183,90 @@ class OffPolicyTrainer:
             if group is not None:
                 group.close()
             plane.close()
+
+    # -- replay-from-log (offline; spill tier as WAL) ------------------------
+    def replay_from_log(self, log_path: str,
+                        max_updates: int | None = None) -> dict:
+        """Offline training replay from the spill tier's write-ahead log.
+
+        Reads every ``shard*.log`` under ``log_path`` (or one explicit
+        file) in the deterministic global segment order ``(seq, shard)``,
+        streams the decoded transitions into an in-process
+        ``UniformReplay`` ring, and runs the off-policy update schedule
+        against it: once the ring passes ``start_sample_size``, each
+        ingested segment is followed by ``updates_per_iter`` sample+learn
+        steps on a key chain derived only from the session seed. Two
+        invocations over the same log therefore produce bit-identical
+        parameters (tested in tests/test_tiers.py) — the spill tier is a
+        durable replay record, not just an archive.
+
+        Torn segments (a crash mid-append, the ``experience.spill``
+        chaos site) are skipped by the reader's magic-resync and counted
+        in the returned ``torn_segments`` — never a crash, never silent.
+
+        Returns {"state", "params_digest", "updates", "rows",
+        "segments", "torn_segments", "metrics"}.
+        """
+        import hashlib
+
+        from surreal_tpu.experience import wire
+        from surreal_tpu.experience.spill import SpillLog
+        from surreal_tpu.replay.uniform import UniformReplay
+
+        if self.device_mode:
+            raise ValueError(
+                "replay-from-log is a host-path mode (the WAL is written "
+                "by the remote plane's shard servers)"
+            )
+        replay = UniformReplay(self._replay_build_cfg)
+        rstate = replay.init(self._replay_example())
+        # loop-carried on this thread only: donate through insert/sample
+        # like the in-process host path does
+        insert = jax.jit(replay.insert, donate_argnums=(0,))
+        sample = jax.jit(replay.sample, donate_argnums=(0,))
+        key = jax.random.key(self.seed)
+        key, init_key = jax.random.split(key)
+        state = self.learner.init(init_key)
+        log = SpillLog(log_path)
+        start = int(self._replay_build_cfg.start_sample_size)
+        upi = int(self.algo.updates_per_iter)
+        updates = rows = segments = size = 0
+        metrics: dict = {}
+        for _header, flat, n in log.segments():
+            batch = wire.unflatten_fields(
+                {k: jnp.asarray(v) for k, v in flat.items()}
+            )
+            rstate = insert(rstate, batch)
+            size = min(size + n, replay.capacity)
+            rows += n
+            segments += 1
+            if size < start:
+                continue
+            done = False
+            for _ in range(upi):
+                if max_updates is not None and updates >= max_updates:
+                    done = True
+                    break
+                key, skey, lkey = jax.random.split(key, 3)
+                rstate, b, _ = sample(rstate, skey)
+                state, metrics = self._learn(state, b, lkey)
+                updates += 1
+            if done:
+                break
+        digest = hashlib.sha256()
+        for leaf in jax.tree.leaves(
+            jax.device_get(getattr(state, "params", state))
+        ):
+            digest.update(np.ascontiguousarray(leaf).tobytes())
+        return {
+            "state": state,
+            "params_digest": digest.hexdigest(),
+            "updates": updates,
+            "rows": rows,
+            "segments": segments,
+            "torn_segments": int(log.torn_segments),
+            "metrics": {
+                k: float(np.asarray(jax.device_get(v)).mean())
+                for k, v in metrics.items()
+            },
+        }

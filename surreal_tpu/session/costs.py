@@ -337,6 +337,36 @@ GAUGE_REGISTRY = {
         "must land in the server's gateway/bad_frames, never a crash)."),
     "loadgen/act_rtt_ms": _g("ms",
         'mean client-observed act round-trip across generator tenants.'),
+    # -- replay tiers (replay/tiers.py, experience/spill.py; ISSUE 18) ------
+    "tier/hot_size": _g("count",
+        "transitions resident in the device hot ring."),
+    "tier/hot_fill": _g("ratio",
+        "hot ring occupancy (size / hot_capacity)."),
+    "tier/hot_hits": _g("count",
+        'updates whose batch was drawn on-device from the hot tier '
+        '(no wire frame, no host->device transfer).'),
+    "tier/hot_misses": _g("count",
+        'updates that fell back to the warm shard fan-in (hot ring '
+        'still filling) — counted, never silent.'),
+    "tier/spill_segments": _g("count",
+        'WAL segments appended across shards (experience/spill.py).'),
+    "tier/spill_rows": _g("count",
+        'transitions spilled to the WAL across shards.'),
+    "tier/spill_bytes": _g("bytes",
+        'total WAL bytes on disk across shards (framed, after '
+        'quantization).'),
+    "tier/spill_errors": _g("count",
+        'WAL appends that failed (ENOSPC, IO error) — the writer '
+        'degrades and the warm ring keeps serving.'),
+    "tier/spill_failed": _g("count",
+        'shards whose writer latched off after consecutive append '
+        'failures (1 per latched shard).'),
+    "tier/cold_bytes_per_row": _g("bytes",
+        'encoded WAL bytes per transition (the quantization win vs the '
+        'raw f32 row — BENCH_tiers.json commits the ratio).'),
+    "tier/torn_segments": _g("count",
+        'torn WAL segments skipped by magic-resync on read (crash '
+        'mid-append; the experience.spill chaos site drives this).'),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

@@ -54,6 +54,17 @@ Sites and the kinds they honor:
                          (``delay_sample``: sleep ``ms`` before serving —
                          drives the sampler's bounded retry and the
                          sample-wait gauge)
+    experience.spill     every spill-tier WAL segment append
+                         (``experience/spill.py``; ``truncate_segment``:
+                         write only a prefix of the frame — a crash
+                         mid-write; the reader must skip the torn frame,
+                         resync on the next magic, and count it in
+                         ``tier/torn_segments``; ``enospc``: raise
+                         ENOSPC at the append — the writer counts the
+                         error and degrades, the warm ring keeps
+                         serving; ``delay_fsync``: sleep ``ms`` before
+                         the fsync — durability latency never stalls
+                         ingest correctness)
     experience.send      every ExperienceSender wire frame
                          (``corrupt_wire_frame``: scramble the outgoing
                          frame bytes — the shard must count+drop it and
@@ -153,6 +164,7 @@ SITES = frozenset(
         "experience.shard",
         "experience.sample",
         "experience.send",
+        "experience.spill",
         "fleet.replica",
         "param.publish",
         "gateway.session",

@@ -116,6 +116,10 @@ _DONATION_SCOPED_SOURCES = (
     "learners", "parallel/dp.py", "parallel/learner_group.py",
     "launch/trainer.py", "launch/offpolicy_trainer.py",
     "launch/seed_trainer.py", "launch/multihost_trainer.py",
+    # the hot replay tier (ISSUE 18): its insert donates the
+    # capacity-sized ring while its sample must NOT donate — exactly the
+    # class of decision this lint forces to be written down
+    "replay/tiers.py",
 )
 
 
@@ -296,6 +300,11 @@ _DATA_PLANE_STEADY_STATE = (
     # real GatewaySession codec — its adversarial profile sends raw
     # hostile bytes, never a pickle of its own
     "gateway/loadgen.py",
+    # the replay tiers (ISSUE 18): the spill WAL is struct-framed
+    # JSON-header + raw column bytes (wire.py codec discipline), and the
+    # hot tier never leaves the device — neither may pickle
+    "experience/spill.py",
+    "replay/tiers.py",
 )
 
 
@@ -385,7 +394,7 @@ def test_perf_gauges_appear_in_registry():
 
     lit = re.compile(
         r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo"
-        r"|lineage|trace|remediation|loadgen|lgroup)"
+        r"|lineage|trace|remediation|loadgen|lgroup|tier)"
         r"/[a-z0-9_]+)[\"']"
     )
     bad = []
@@ -410,7 +419,7 @@ def test_perf_gauges_appear_in_registry():
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
              "gateway/", "ops/", "slo/", "lineage/", "trace/",
-             "remediation/", "loadgen/", "lgroup/")
+             "remediation/", "loadgen/", "lgroup/", "tier/")
         ), name
 
 

@@ -417,7 +417,7 @@ def test_sp_fused_trainer_runs_and_learn_matches_unsharded(tmp_path):
     the op-level ring-vs-full test)."""
     from surreal_tpu.launch.trainer import Trainer
 
-    spt = Trainer(_sp_trainer_cfg(tmp_path, "sp", sp=8))
+    spt = Trainer(_sp_trainer_cfg(tmp_path, "sp", sp=8, iters=1))
     assert spt.learner.model.mesh is spt.mesh  # ring attention bound
     _, m_sp = spt.run()
     for k in ("loss/pg", "loss/value", "policy/kl"):

@@ -420,8 +420,12 @@ def _assert_trees_close(a, b, rtol, atol):
                      marks=pytest.mark.slow),
         pytest.param({"gae_unroll": 4}, UNROLL_RTOL, UNROLL_ATOL,
                      marks=pytest.mark.slow),
-        ({"rollout_unroll": 8, "sgd_unroll": 2, "gae_unroll": 2},
-         UNROLL_RTOL, UNROLL_ATOL),
+        # the combined-unroll variant joined the slow tier in the ISSUE 18
+        # headroom pass: every knob it exercises is individually covered
+        # above, and tier-1 retains unroll equivalence through the ddpg
+        # rollout variant below plus the pallas impl here
+        pytest.param({"rollout_unroll": 8, "sgd_unroll": 2, "gae_unroll": 2},
+                     UNROLL_RTOL, UNROLL_ATOL, marks=pytest.mark.slow),
         pytest.param({"gae_impl": "assoc"}, IMPL_RTOL, IMPL_ATOL,
                      marks=pytest.mark.slow),
         ({"gae_impl": "pallas"}, IMPL_RTOL, IMPL_ATOL),
@@ -458,7 +462,14 @@ def test_ddpg_tuned_program_matches_default(tmp_path, variant):
 
 @pytest.mark.parametrize(
     "variant",
-    [{"rollout_unroll": 4}, {"gae_unroll": 4}],
+    [
+        # tier-1 keeps the vtrace-unroll variant — the recurrence is
+        # impala's distinct arithmetic; rollout-unroll equivalence stays
+        # tier-1-covered by the ddpg rollout variant above (ISSUE 18
+        # suite-wall headroom pass, same precedent as the ddpg sweep)
+        pytest.param({"rollout_unroll": 4}, marks=pytest.mark.slow),
+        {"gae_unroll": 4},
+    ],
     ids=["rollout", "vtrace"],
 )
 def test_impala_tuned_program_matches_default(tmp_path, variant):
