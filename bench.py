@@ -342,6 +342,14 @@ def main() -> int:
         from perf_wallclock import learner_group_main
 
         return learner_group_main(sys.argv[1:])
+    if "--loop-engine" in sys.argv:
+        # loop-engine campaign (ISSUE 19): per-driver iteration time with
+        # boundary pipelining off (the legacy inline loop) vs on, plus the
+        # off-critical-path fraction the deferral reclaims — writes
+        # BENCH_engine.json (perf_gate's engine gate consumes it)
+        from perf_wallclock import engine_main
+
+        return engine_main(sys.argv[1:])
     global AUTOTUNE, TUNING_CACHE_DIR, PRECISION
     if "--autotune" in sys.argv:
         AUTOTUNE = sys.argv[sys.argv.index("--autotune") + 1]

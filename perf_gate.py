@@ -708,6 +708,67 @@ def gate_replay_tiers(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_engine(art_dir: str, out=sys.stdout) -> int:
+    """Loop-engine gate (ISSUE 19), from ``BENCH_engine.json``
+    (``bench.py --loop-engine``): per ported driver, the pipelined arm's
+    steady-state iteration time must sit at or below the legacy inline
+    arm's within ``tol`` (5%) — deferring the boundary must never tax
+    the critical path — and the pipelined arm must actually have
+    deferred boundaries (a no-op 'on' arm reading as parity would be a
+    fabricated win).
+
+    One-core honesty: under mode='honesty' (< 2 cores, the staging
+    worker time-slices the compute thread) the measured ratios are
+    recorded as-is and only their presence is enforced — the <= bound
+    waits for a box where overlap is physically possible, and the mode
+    rides the artifact so a one-core run can't masquerade as a
+    measured speedup. rc 0 with a note when the artifact is absent or
+    from a failed campaign."""
+    path = os.path.join(art_dir, "BENCH_engine.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no BENCH_engine.json — loop engine not "
+              "measured (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or not data.get("drivers"):
+        print("perf_gate: BENCH_engine.json is from a FAILED campaign "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    tol = float(data.get("tol", 0.05))
+    mode = str(data.get("mode", "honesty"))
+    enforce = mode == "overlap"
+    for name, row in sorted(data["drivers"].items()):
+        ratio = row.get("iter_ratio_on_vs_off")
+        if ratio is None:
+            print(f"perf_gate: engine driver {name} has no measured "
+                  "ratio — the arm did not complete", file=out)
+            rc = 1
+            continue
+        deferred = float((row.get("on") or {}).get(
+            "deferred_boundaries") or 0.0)
+        if deferred <= 0.0:
+            print(f"perf_gate: engine driver {name} pipelined arm "
+                  "deferred ZERO boundaries — pipelining never engaged",
+                  file=out)
+            rc = 1
+            continue
+        line = (f"perf_gate: engine {name} pipelined/legacy iter ratio "
+                f"{float(ratio):.3f}, commitment <= {1 + tol:.2f}")
+        if enforce and float(ratio) > 1.0 + tol:
+            print(line + " — PIPELINING TAXES THE CRITICAL PATH", file=out)
+            rc = 1
+        elif enforce:
+            print(line + " — ok", file=out)
+        else:
+            print(line + f" — recorded (mode={mode}, "
+                  f"{data.get('cores', '?')} core(s); bound deferred to "
+                  "a multi-core round)", file=out)
+    return rc
+
+
 def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     """The tier-1 wall-clock budget guard (ISSUE 13 satellite): the
     committed ``BENCH_tier1.json`` audit (one real ``--durations=15``
@@ -777,7 +838,8 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
         gate_gateway(art_dir, out=out), gate_ops(art_dir, out=out),
         gate_trace(art_dir, out=out), gate_watchdog(art_dir, out=out),
         gate_control(art_dir, out=out), gate_learner_group(art_dir, out=out),
-        gate_replay_tiers(art_dir, out=out), gate_tier1(art_dir, out=out),
+        gate_replay_tiers(art_dir, out=out), gate_engine(art_dir, out=out),
+        gate_tier1(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

@@ -1761,6 +1761,99 @@ def _replay_tiers_lines() -> list[str]:
     return lines
 
 
+def _load_engine_bench():
+    """Load the loop-engine artifact (``BENCH_engine.json``, written by
+    ``bench.py --loop-engine``) if present — the BENCH_host.json
+    discipline: PERF.md regens preserve the measured section without
+    re-running."""
+    try:
+        with open("BENCH_engine.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict) or not data.get("drivers"):
+        return None  # failed-campaign artifact
+    return data
+
+
+def _engine_lines() -> list[str]:
+    """The 'Loop engine' PERF.md section: static mechanism text plus the
+    per-driver off-vs-on table from the BENCH_engine.json artifact. One
+    function so ``main()`` and the committed PERF.md cannot drift."""
+    lines = [
+        "",
+        "## Loop engine (software-pipelined iteration boundary)",
+        "",
+        "All five single-host driver loops (fused/alternate/overlap PPO, "
+        "device/host off-policy, SEED) and the three multi-host "
+        "subclasses run on ONE iteration skeleton "
+        "(`engine/core.py::LoopEngine`): each driver declares its stages "
+        "(`collect -> stage -> learn` plus the shared "
+        "`publish/checkpoint/recover/observe` side-bands) as `StageSpec` "
+        "rows with an EXPLICIT donation bit, and hands the engine a step "
+        "closure. With `session_config.engine.pipeline_sidebands` off "
+        "(default) the boundary runs inline and the engine is "
+        "bit-identical to the historical loops (tested per driver, "
+        "params digest + metrics rows + checkpoint bytes). With it on, "
+        "the boundary — metrics sync (the one `float()` device fence), "
+        "publish, checkpoint, tracer/ops emits — is submitted to a "
+        "single staging worker and overlaps iteration k+1's "
+        "collect/learn. Donation safety: when any declared stage "
+        "donates (the fused device programs jit with "
+        "`donate_argnums=(0, 1)`), the param tree is snapshotted with "
+        "`jax.tree.map(jnp.copy, ...)` BEFORE the next donating "
+        "dispatch can reuse the buffers; host drivers pass the "
+        "reference (rebinding, never mutation, is the loop discipline). "
+        "Stop/recovery verdicts land with at most one iteration of lag; "
+        "a wedged boundary (the `engine.stage` chaos site) gets "
+        "`stage_timeout_s` before subsequent boundaries are skipped — "
+        "counted in `engine/skipped_boundaries`, never silent — and the "
+        "SIGTERM latch is checked inline every iteration, so preemption "
+        "stops at an iteration boundary with the emergency checkpoint "
+        "intact under overlap (tested).",
+    ]
+    eb = _load_engine_bench()
+    if eb:
+        lines += [
+            "",
+            f"Measured through the real drivers ({eb['geometry']}; "
+            f"`BENCH_engine.json`, platform `{eb.get('platform')}`, "
+            f"{eb.get('cores', '?')} core(s), mode `{eb.get('mode')}`; "
+            f"median of {eb.get('meas_iters')} steady-state iterations):",
+            "",
+            "| Driver | geometry | legacy iter ms | pipelined iter ms | "
+            "ratio | boundary share reclaimed |",
+            "|---|---|---|---|---|---|",
+        ]
+        for name in sorted(eb["drivers"]):
+            r = eb["drivers"][name]
+            off, on = r.get("off") or {}, r.get("on") or {}
+            rec = r.get("reclaimed_frac")
+            lines.append(
+                "| {n} | {g} | {o:.1f} | {p:.1f} | {ra:.3f} | {re} |".format(
+                    n=name, g=r.get("geometry"),
+                    o=float(off.get("iter_ms", 0)),
+                    p=float(on.get("iter_ms", 0)),
+                    ra=float(r.get("iter_ratio_on_vs_off") or 0),
+                    re=f"{float(rec):.1%}" if rec is not None else "-",
+                )
+            )
+        if eb.get("mode") != "overlap":
+            lines += [
+                "",
+                "One-core honesty: this box has "
+                f"{eb.get('cores', 1)} CPU core(s), so the staging "
+                "worker time-slices the compute thread and the arms "
+                "measure bookkeeping overhead, not overlap — the "
+                "`perf_gate.gate_engine` <= bound is enforced only "
+                "under mode `overlap` (>= 2 cores). The committed win "
+                "on this image is the reclaimed-share column: the "
+                "boundary work that LEAVES the critical path once a "
+                "second core exists.",
+            ]
+    return lines
+
+
 def _autotuner_lines() -> list[str]:
     """The 'Program autotuner' PERF.md section: static mechanism text plus
     the measured table from the BENCH_tune.json artifact when one exists.
@@ -2395,6 +2488,7 @@ def main(argv=None) -> None:
     lines += _watchdog_lines()
     lines += _control_lines()
     lines += _replay_tiers_lines()
+    lines += _engine_lines()
     if scaling:
         lines += [
             "",

@@ -2112,6 +2112,250 @@ def learner_group_main(argv) -> int:
     return 0
 
 
+# -- loop-engine campaign (ISSUE 19) -----------------------------------------
+
+ENGINE_WARM_ITERS = 2    # jit compile + cache warmup land here
+ENGINE_MEAS_ITERS = 6    # median over these
+ENGINE_TOL = 0.05        # pipelined iter-time must be <= legacy * (1+tol)
+ENGINE_HEADLINE = (512, 64)  # device drivers run the 512x64 geometry
+
+
+def _engine_cfgs():
+    """One (name, geometry, make_cfg) per driver loop the engine ports.
+
+    Device drivers (fused PPO, fused DDPG) run the 512x64 headline
+    geometry; host and SEED drivers run reduced geometries — each row
+    records its own, so the artifact can't silently mix scales."""
+    from surreal_tpu.session.config import Config
+    from surreal_tpu.session.default_configs import base_config
+
+    ne, hz = ENGINE_HEADLINE
+
+    def session(folder, pipeline, **extra):
+        return Config(
+            folder=folder,
+            total_env_steps=10**12,  # stopped by the on_metrics budget
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            # a real checkpoint rides every other boundary, so the
+            # pipelined arm defers actual side-band work, not empty calls
+            checkpoint=Config(every_n_iters=2),
+            eval=Config(every_n_iters=0),
+            engine=Config(pipeline_sidebands=pipeline),
+            **extra,
+        )
+
+    def ppo_device(folder, pipeline):
+        return Config(
+            learner_config=Config(algo=Config(name="ppo", horizon=hz)),
+            env_config=Config(name="jax:cartpole", num_envs=ne),
+            session_config=session(folder, pipeline, seed=7),
+        ).extend(base_config())
+
+    def ppo_host(overlap):
+        def make(folder, pipeline):
+            return Config(
+                learner_config=Config(
+                    algo=Config(name="ppo", horizon=64, epochs=2)
+                ),
+                env_config=Config(name="gym:CartPole-v1", num_envs=8),
+                session_config=session(
+                    folder, pipeline, seed=7,
+                    topology=Config(overlap_rollouts=overlap),
+                ),
+            ).extend(base_config())
+
+        return make
+
+    def ddpg_device(folder, pipeline):
+        return Config(
+            learner_config=Config(
+                algo=Config(
+                    name="ddpg", horizon=hz, updates_per_iter=4,
+                    exploration=Config(warmup_steps=0),
+                ),
+                replay=Config(
+                    kind="uniform", capacity=131072,
+                    start_sample_size=8192, batch_size=256,
+                ),
+            ),
+            env_config=Config(name="jax:pendulum", num_envs=ne),
+            session_config=session(folder, pipeline, seed=7),
+        ).extend(base_config())
+
+    def ddpg_host(folder, pipeline):
+        return Config(
+            learner_config=Config(
+                algo=Config(
+                    name="ddpg", horizon=32, n_step=3, updates_per_iter=2,
+                    exploration=Config(warmup_steps=0),
+                ),
+                replay=Config(
+                    kind="uniform", capacity=4096,
+                    start_sample_size=64, batch_size=32,
+                ),
+            ),
+            env_config=Config(name="gym:Pendulum-v1", num_envs=4),
+            session_config=session(folder, pipeline, seed=7),
+        ).extend(base_config())
+
+    def seed(folder, pipeline):
+        return Config(
+            learner_config=Config(algo=Config(name="impala", horizon=8)),
+            env_config=Config(name="gym:CartPole-v1", num_envs=4),
+            session_config=session(
+                folder, pipeline, seed=7,
+                topology=Config(num_env_workers=2),
+            ),
+        ).extend(base_config())
+
+    return [
+        ("ppo_device", f"jax:cartpole {ne}x{hz}", ppo_device),
+        ("ppo_host_alternate", "gym:CartPole 8x64 (overlap off)",
+         ppo_host(False)),
+        ("ppo_host_overlap", "gym:CartPole 8x64 (overlap on)",
+         ppo_host(True)),
+        ("ddpg_device", f"jax:pendulum {ne}x{hz}, 4 updates/iter",
+         ddpg_device),
+        ("ddpg_host", "gym:Pendulum 4x32, n_step 3", ddpg_host),
+        ("seed", "impala gym:CartPole 4x8, 2 thread workers", seed),
+    ]
+
+
+def _engine_arm(name: str, make_cfg, pipeline: bool) -> dict:
+    """One driver run at one engine mode; median steady-state iter time
+    plus the engine's own gauges from the last metrics row."""
+    import shutil
+    import tempfile
+
+    from surreal_tpu.main.launch import select_trainer
+
+    folder = tempfile.mkdtemp(prefix=f"bench_engine_{name}_")
+    trainer = select_trainer(make_cfg(folder, pipeline))
+    marks: list[float] = []
+    last: dict = {}
+
+    def on_m(it, m):
+        marks.append(time.perf_counter())
+        last.update(m)
+        return len(marks) >= ENGINE_WARM_ITERS + ENGINE_MEAS_ITERS
+
+    try:
+        trainer.run(on_metrics=on_m)
+    finally:
+        shutil.rmtree(folder, ignore_errors=True)
+    tail = marks[ENGINE_WARM_ITERS - 1:]
+    diffs = sorted(b - a for a, b in zip(tail, tail[1:]))
+    iter_ms = diffs[len(diffs) // 2] * 1e3
+    return {
+        "iter_ms": round(iter_ms, 3),
+        "iters_measured": len(diffs),
+        "boundary_p50_ms": last.get("engine/stage_p50_ms"),
+        "occupancy": last.get("engine/occupancy"),
+        "deferred_boundaries": last.get("engine/deferred_boundaries"),
+        "skipped_boundaries": last.get("engine/skipped_boundaries"),
+    }
+
+
+def _engine_measure() -> dict:
+    """Every ported driver, pipelining off then on. The off arm IS the
+    legacy loop (the engine runs the boundary inline); the on arm defers
+    publish/checkpoint/observe to the staging worker. reclaimed_frac is
+    the inline boundary's share of the legacy iteration — the fraction
+    of the critical path the pipelined arm moves off it."""
+    import sys
+
+    drivers = {}
+    for name, geometry, make_cfg in _engine_cfgs():
+        off = _engine_arm(name, make_cfg, False)
+        on = _engine_arm(name, make_cfg, True)
+        ratio = (
+            on["iter_ms"] / off["iter_ms"] if off["iter_ms"] else None
+        )
+        reclaimed = (
+            float(off["boundary_p50_ms"]) / off["iter_ms"]
+            if off.get("boundary_p50_ms") and off["iter_ms"] else None
+        )
+        drivers[name] = {
+            "geometry": geometry,
+            "off": off,
+            "on": on,
+            "iter_ratio_on_vs_off": round(ratio, 4) if ratio else None,
+            "reclaimed_frac": (
+                round(reclaimed, 4) if reclaimed is not None else None
+            ),
+        }
+        print(
+            f"engine bench {name}: off {off['iter_ms']:.1f} ms, "
+            f"on {on['iter_ms']:.1f} ms (ratio {ratio:.3f})",
+            file=sys.stderr,
+        )
+    return drivers
+
+
+def engine_main(argv) -> int:
+    """--loop-engine driver (ISSUE 19): per-driver iteration time with
+    boundary pipelining off (the legacy inline loop) vs on, plus the
+    off-critical-path fraction the deferral reclaims. Writes
+    ``BENCH_engine.json`` for ``perf_gate.gate_engine`` and PERF.md's
+    loop-engine table. On a one-core box the staging worker time-slices
+    the compute thread, so the arms are recorded in mode='honesty' — the
+    <= bound is only enforced under mode='overlap' (>= 2 cores)."""
+    import os
+    import sys
+
+    from bench import RETRY_ATTEMPTS, RETRY_BACKOFF_S, _is_retryable, _reset_backends
+
+    out_path = "BENCH_engine.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    cores = os.cpu_count() or 1
+    err = None
+    for attempt in range(RETRY_ATTEMPTS):
+        try:
+            drivers = _engine_measure()
+            headline = drivers["ppo_device"]
+            result = {
+                "metric": "engine_pipelined_iter_ratio_ppo_device",
+                "value": headline["iter_ratio_on_vs_off"],
+                "unit": "ratio (pipelined / legacy iteration time)",
+                "geometry": (
+                    f"device drivers at {ENGINE_HEADLINE[0]}x"
+                    f"{ENGINE_HEADLINE[1]}; host/SEED reduced geometries "
+                    "recorded per row"
+                ),
+                "tol": ENGINE_TOL,
+                "cores": cores,
+                "mode": "overlap" if cores >= 2 else "honesty",
+                "warm_iters": ENGINE_WARM_ITERS,
+                "meas_iters": ENGINE_MEAS_ITERS,
+                "drivers": drivers,
+                "device": str(jax.devices()[0].device_kind),
+                "platform": str(jax.devices()[0].platform),
+            }
+            with open(out_path, "w") as f:
+                json.dump(result, f, indent=2, default=float)
+            print(json.dumps(result, default=float))
+            return 0
+        except Exception as e:  # noqa: BLE001 — the artifact records it
+            err = f"{type(e).__name__}: {e}"
+            if attempt < RETRY_ATTEMPTS - 1 and _is_retryable(e):
+                wait = RETRY_BACKOFF_S * 2**attempt
+                print(
+                    f"loop-engine attempt {attempt + 1}/{RETRY_ATTEMPTS} "
+                    f"failed ({err}); retrying in {wait:.0f}s",
+                    file=sys.stderr,
+                )
+                time.sleep(wait)
+                _reset_backends()
+                continue
+            break
+    result = {"error": err, "parsed": None}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys

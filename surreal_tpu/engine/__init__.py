@@ -1,0 +1,31 @@
+"""One pipelined loop runtime (ISSUE 19).
+
+Every driver used to hand-thread the same iteration skeleton — fire
+chaos, step, bump counters, run the SessionHooks boundary, roll back or
+stop — and every driver serialized the boundary's side-band stages
+(publish/checkpoint/observe/ops-push) onto the learn critical path.
+``LoopEngine`` owns that skeleton once: drivers declare their stage
+program (`StageSpec`, donation decision mandatory) and supply a step
+closure; the engine software-pipelines the side-band boundary onto a
+bounded staging executor overlapped with iteration k+1's collect/learn
+when ``session.engine.pipeline_sidebands`` is on, and is bit-identical
+to the historical inline loops when it is off (the default).
+"""
+
+from surreal_tpu.engine.core import LoopEngine, LoopState, Outcome
+from surreal_tpu.engine.stages import (
+    EngineConfig,
+    StageSpec,
+    overlap_collect,
+    sideband_stages,
+)
+
+__all__ = [
+    "EngineConfig",
+    "LoopEngine",
+    "LoopState",
+    "Outcome",
+    "StageSpec",
+    "overlap_collect",
+    "sideband_stages",
+]

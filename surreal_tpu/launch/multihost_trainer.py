@@ -56,6 +56,14 @@ from typing import Callable
 import jax
 import numpy as np
 
+from surreal_tpu.engine import (
+    EngineConfig,
+    LoopEngine,
+    LoopState,
+    Outcome,
+    StageSpec,
+    sideband_stages,
+)
 from surreal_tpu.launch.hooks import SessionHooks, host_metrics
 from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
 from surreal_tpu.launch.rollout import host_rollout, init_device_carry
@@ -321,9 +329,21 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
         try:
             hooks, state, iteration, env_steps = self._begin_session(state)
             tracer, heartbeat = self._telemetry(hooks)
+            ls = LoopState(
+                state=state, key=key, iteration=iteration,
+                env_steps=env_steps,
+            )
 
             def lazy_host_state():
-                return _to_host_local(state)
+                return _to_host_local(ls.state)
+
+            # the boundary stays inline on every rank (EngineConfig.inline):
+            # a deferred, rank-local stop decision would race the agreed
+            # collective stop schedule
+            engine_cfg = EngineConfig.from_session(cfg).inline()
+
+            def after_step(ls):
+                heartbeat.beat(ls.iteration, ls.env_steps)
 
             if self.device_mode:
                 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -333,7 +353,7 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                 # process computes only its addressable shards. Per-env
                 # seeding comes from the global env index (the split inside
                 # init_device_carry), so no rank folding is needed.
-                carry = jax.jit(
+                ls.extras["carry"] = jax.jit(
                     lambda k: init_device_carry(
                         self.env, k, self.global_num_envs
                     ),
@@ -344,31 +364,35 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                     # cost/MFU accounting (rank 0): lower + HLO cost pass
                     # are rank-local — no collective, no compile
                     hooks.record_program_costs(
-                        "train_iter", self._train_iter, state, carry,
-                        jax.random.fold_in(key, 0), phase="train_iter",
+                        "train_iter", self._train_iter, state,
+                        ls.extras["carry"], jax.random.fold_in(key, 0),
+                        phase="train_iter",
                     )
-                while env_steps < total:
-                    key, it_key, hk_key = jax.random.split(key, 3)
+                stages = (
+                    StageSpec("collect", donate=True),
+                    StageSpec("learn", donate=True),
+                ) + sideband_stages()
+
+                def step(ls):
+                    ls.key, it_key, hk_key = jax.random.split(ls.key, 3)
                     # unfenced dispatch span (see launch/trainer.py's note)
                     with tracer.span("train_iter"):
-                        state, carry, metrics = self._train_iter(
-                            state, carry, it_key
+                        ls.state, ls.extras["carry"], metrics = (
+                            self._train_iter(
+                                ls.state, ls.extras["carry"], it_key
+                            )
                         )
-                    iteration += 1
-                    env_steps += steps_per_iter
-                    heartbeat.beat(iteration, env_steps)
-                    stop = False
-                    if hooks is not None:
-                        _, stop = hooks.end_iteration(
-                            iteration, env_steps, lazy_host_state, hk_key,
-                            metrics, on_metrics,
-                        )
-                    if maybe_agree_stop(iteration, stop):
-                        break
+                    return Outcome(
+                        metrics=metrics, hook_key=hk_key,
+                        steps=steps_per_iter,
+                        state_for_hooks=lazy_host_state,
+                    )
             else:
-                obs = self.env.reset(
-                    seed=self.config.env_config.seed + self.rank
-                )
+                obs_holder = [
+                    self.env.reset(
+                        seed=self.config.env_config.seed + self.rank
+                    )
+                ]
                 from collections import deque
 
                 from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
@@ -376,46 +400,59 @@ class MultiHostTrainer(_MultiHostSession, Trainer):
                 recent_returns: deque = deque(maxlen=HOST_METRICS_WINDOW)
                 # full local copy ONCE (moments land on device and stay);
                 # per-iteration refreshes graft params + obs_stats only
-                act_base = jax.device_put(lazy_host_state())
-                while env_steps < total:
-                    key, r_key, l_key, hk_key = jax.random.split(key, 4)
+                act_holder = [jax.device_put(lazy_host_state())]
+                stages = (
+                    StageSpec("collect", donate=False),
+                    StageSpec("learn", donate=False),
+                ) + sideband_stages()
+
+                def step(ls):
+                    ls.key, r_key, l_key, hk_key = jax.random.split(ls.key, 4)
                     # act against a host-local param copy (the SEED host
                     # loop is per-process; only learn is global), with
                     # per-rank exploration streams. One params+stats
                     # upload per ITERATION: shipping the numpy pytree
                     # straight into the per-step jitted act would re-pay
                     # it every env step of the rollout
-                    act_base = _acting_refresh(act_base, state)
+                    act_holder[0] = _acting_refresh(act_holder[0], ls.state)
                     with tracer.span("rollout"):
-                        obs, batch, ep_stats = host_rollout(
-                            self.env, self._act, act_base, obs,
-                            jax.random.fold_in(r_key, self.rank), self.horizon,
+                        obs_holder[0], batch, ep_stats = host_rollout(
+                            self.env, self._act, act_holder[0],
+                            obs_holder[0],
+                            jax.random.fold_in(r_key, self.rank),
+                            self.horizon,
                         )
-                    gbatch = local_batch_to_global(self.mesh, batch, batch_dim=1)
+                    gbatch = local_batch_to_global(
+                        self.mesh, batch, batch_dim=1
+                    )
                     with tracer.span("learn"):
-                        state, metrics = self._learn(state, gbatch, l_key)
+                        ls.state, metrics = self._learn(
+                            ls.state, gbatch, l_key
+                        )
                     if hooks is not None:
                         # first iteration only (idempotent): the learn
                         # program needs a representative global batch
                         hooks.record_program_costs(
-                            "learn", self._learn, state, gbatch, l_key,
+                            "learn", self._learn, ls.state, gbatch, l_key,
                             phase="learn",
                         )
-                    iteration += 1
-                    env_steps += steps_per_iter
-                    heartbeat.beat(iteration, env_steps)
                     recent_returns.extend(ep_stats["returns"])
-                    stop = False
-                    if hooks is not None:
-                        # episode stats are rank-0-local (each host sees
-                        # only its own episodes); learner metrics are
-                        # global — the psum already crossed hosts
-                        _, stop = hooks.end_iteration(
-                            iteration, env_steps, lazy_host_state, hk_key,
-                            host_metrics(metrics, recent_returns), on_metrics,
-                        )
-                    if maybe_agree_stop(iteration, stop):
-                        break
+                    # episode stats are rank-0-local (each host sees
+                    # only its own episodes); learner metrics are
+                    # global — the psum already crossed hosts
+                    return Outcome(
+                        metrics=host_metrics(metrics, recent_returns),
+                        hook_key=hk_key, steps=steps_per_iter,
+                        state_for_hooks=lazy_host_state,
+                    )
+
+            engine = LoopEngine(
+                hooks, total, step, stages, engine_cfg,
+                on_metrics=on_metrics, after_step=after_step,
+                agree_stop=maybe_agree_stop, fire_faults=False,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             return state, self._end_session(
                 hooks, iteration, env_steps, lazy_host_state
             )
@@ -488,9 +525,6 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
             hooks, state, iteration, env_steps = self._begin_session(state)
             tracer, heartbeat = self._telemetry(hooks)
 
-            def lazy_host_state():
-                return _to_host_local(state)
-
             # SPMD carry init: one jitted program over the global mesh;
             # each process materializes only its addressable env shards.
             carry_shapes = jax.eval_shape(self._init_carry, env_key)
@@ -508,7 +542,6 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
                 self.replay, self._replay_example(), self.mesh
             )
 
-            first_call = True
             import jax.numpy as jnp
 
             if hooks is not None:
@@ -520,32 +553,65 @@ class MultiHostOffPolicyTrainer(_MultiHostSession, OffPolicyTrainer):
                     phase="train_iter",
                 )
 
-            while env_steps < total:
-                key, it_key, hk_key = jax.random.split(key, 3)
+            ls = LoopState(
+                state=state, key=key, iteration=iteration,
+                env_steps=env_steps,
+                extras={
+                    "replay": replay_state, "carry": carry,
+                    "first_call": True,
+                },
+            )
+
+            def lazy_host_state():
+                return _to_host_local(ls.state)
+
+            def after_step(ls):
+                heartbeat.beat(ls.iteration, ls.env_steps)
+
+            stages = (
+                StageSpec("collect", donate=True),
+                StageSpec("stage", donate=True),
+                StageSpec("learn", donate=True),
+            ) + sideband_stages()
+
+            def step(ls):
+                ls.key, it_key, hk_key = jax.random.split(ls.key, 3)
                 # beta/warmup derive from env_steps, identical on every
                 # rank (same counter chain) -> consistent replicated inputs
-                beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
+                beta = jnp.asarray(
+                    self._beta(ls.env_steps, total), jnp.float32
+                )
                 warmup = jnp.asarray(
-                    env_steps < self.algo.exploration.warmup_steps
+                    ls.env_steps < self.algo.exploration.warmup_steps
                 )
                 # unfenced dispatch span (see launch/trainer.py's note)
                 with tracer.span("train_iter"):
-                    state, replay_state, carry, metrics = self._train_iter(
-                        state, replay_state, carry, it_key, beta, warmup,
-                        jnp.asarray(first_call),
+                    (
+                        ls.state, ls.extras["replay"], ls.extras["carry"],
+                        metrics,
+                    ) = self._train_iter(
+                        ls.state, ls.extras["replay"], ls.extras["carry"],
+                        it_key, beta, warmup,
+                        jnp.asarray(ls.extras["first_call"]),
                     )
-                first_call = False
-                iteration += 1
-                env_steps += steps_per_iter
-                heartbeat.beat(iteration, env_steps)
-                stop = False
-                if hooks is not None:
-                    _, stop = hooks.end_iteration(
-                        iteration, env_steps, lazy_host_state, hk_key,
-                        metrics, on_metrics,
-                    )
-                if self._maybe_agree_stop(iteration, stop, metrics_every):
-                    break
+                ls.extras["first_call"] = False
+                return Outcome(
+                    metrics=metrics, hook_key=hk_key, steps=steps_per_iter,
+                    state_for_hooks=lazy_host_state,
+                )
+
+            # inline boundary on every rank — see MultiHostTrainer.run
+            engine = LoopEngine(
+                hooks, total, step, stages,
+                EngineConfig.from_session(cfg).inline(),
+                on_metrics=on_metrics, after_step=after_step,
+                agree_stop=lambda it, stop: self._maybe_agree_stop(
+                    it, stop, metrics_every
+                ),
+                fire_faults=False,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             return state, self._end_session(
                 hooks, iteration, env_steps, lazy_host_state
             )
@@ -684,7 +750,26 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
             from surreal_tpu.launch.seed_trainer import hop_event
 
             learn_ms: deque = deque(maxlen=256)
-            while env_steps < total:
+            ls = LoopState(
+                state=state, key=key, iteration=iteration,
+                env_steps=env_steps,
+            )
+
+            def lazy_ls_state():
+                return _to_host_local(ls.state)
+
+            lazy_host_state = lazy_ls_state
+
+            def after_step(ls):
+                heartbeat.beat(ls.iteration, ls.env_steps)
+                plane.supervise()
+
+            stages = (
+                StageSpec("collect", donate=False, overlap=True),
+                StageSpec("learn", donate=True),
+            ) + sideband_stages()
+
+            def step(ls):
                 with tracer.span("chunk-wait"):
                     chunk = plane.next_chunk()
                 versions = chunk.pop("param_version")
@@ -694,28 +779,23 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                 chunk.pop("_exemplar", None)
                 staleness = server.version - int(versions.min())
                 gbatch = local_batch_to_global(self.mesh, chunk, batch_dim=1)
-                key, lkey, hk_key = jax.random.split(key, 3)
+                ls.key, lkey, hk_key = jax.random.split(ls.key, 3)
                 t_learn0 = time.perf_counter()
                 with tracer.span("learn"):
-                    state, metrics = self._learn(state, gbatch, lkey)
+                    ls.state, metrics = self._learn(ls.state, gbatch, lkey)
                 learn_ms.append((time.perf_counter() - t_learn0) * 1e3)
                 if hooks is not None:
                     # first iteration only (idempotent)
                     hooks.record_program_costs(
-                        "learn", self._learn, state, gbatch, lkey,
+                        "learn", self._learn, ls.state, gbatch, lkey,
                         phase="learn",
                     )
                 with tracer.span("param-publish"):
                     server.set_act_fn(
                         self._make_act_fn(
-                            self._refresh_act_state(state), key_holder
+                            self._refresh_act_state(ls.state), key_holder
                         )
                     )
-                iteration += 1
-                env_steps += steps_per_iter
-                heartbeat.beat(iteration, env_steps)
-                plane.supervise()
-                stop_flag = False
                 if hooks is not None:
                     # learner metrics are global (psum crossed hosts);
                     # server/episode stats are rank-0-local by design
@@ -732,17 +812,31 @@ class MultiHostSEEDTrainer(_MultiHostSession, SEEDTrainer):
                         **server.queue_stats(),
                         **(server.episode_stats() or {}),
                     )
-                    m_row, stop_flag = hooks.end_iteration(
-                        iteration, env_steps, lazy_host_state, hk_key,
-                        metrics, on_metrics,
+
+                def post_metrics(m_row):
+                    # per-hop latency percentiles (host deques only)
+                    hooks.tracer.event(
+                        "hops", **hop_event(server, plane, learn_ms)
                     )
-                    if m_row is not None:
-                        # per-hop latency percentiles (host deques only)
-                        hooks.tracer.event(
-                            "hops", **hop_event(server, plane, learn_ms)
-                        )
-                if self._maybe_agree_stop(iteration, stop_flag, metrics_every):
-                    break
+
+                return Outcome(
+                    metrics=metrics, hook_key=hk_key, steps=steps_per_iter,
+                    state_for_hooks=lazy_ls_state,
+                    post_metrics=post_metrics if hooks is not None else None,
+                )
+
+            # inline boundary on every rank — see MultiHostTrainer.run
+            engine = LoopEngine(
+                hooks, total, step, stages,
+                EngineConfig.from_session(cfg).inline(),
+                on_metrics=on_metrics, after_step=after_step,
+                agree_stop=lambda it, stop: self._maybe_agree_stop(
+                    it, stop, metrics_every
+                ),
+                fire_faults=False,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             return state, self._end_session(
                 hooks, iteration, env_steps, lazy_host_state
             )

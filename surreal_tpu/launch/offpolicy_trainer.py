@@ -24,6 +24,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from surreal_tpu.engine import (
+    EngineConfig,
+    LoopEngine,
+    LoopState,
+    Outcome,
+    StageSpec,
+    overlap_collect,
+    sideband_stages,
+)
 from surreal_tpu.envs import is_jax_env, make_env
 from surreal_tpu.envs.jax.base import batch_step
 from surreal_tpu.launch.hooks import SessionHooks, host_metrics, training_env_config
@@ -552,56 +561,94 @@ class OffPolicyTrainer:
                 jnp.asarray(False), jnp.asarray(True),
                 phase="train_iter",
             )
-            first_call = True
-            while env_steps < total:
-                f = faults.fire("trainer.iteration")
-                if f is not None:
-                    state = faults.apply_trainer_fault(f, state)
-                key, it_key, hk_key = jax.random.split(key, 3)
-                beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
+            # the fused iteration donates state+replay+carry: a deferred
+            # boundary reads a jnp.copy snapshot of the param tree. The
+            # replay-inclusive checkpoint closure must read the EXACT
+            # iteration's ring, so include_replay pins the boundary
+            # inline (EngineConfig.inline) — copying the buffer per
+            # boundary would dwarf the win being bought.
+            stages = (
+                StageSpec("collect", donate=True),
+                StageSpec("stage", donate=True),
+                StageSpec("learn", donate=True),
+            ) + sideband_stages()
+            engine_cfg = EngineConfig.from_session(cfg)
+            if include_replay and engine_cfg.pipeline_sidebands:
+                hooks.log.warning(
+                    "engine.pipeline_sidebands is pinned off: "
+                    "checkpoint.include_replay snapshots the live ring"
+                )
+                engine_cfg = engine_cfg.inline()
+            ls = LoopState(
+                state=state, key=key, iteration=iteration,
+                env_steps=env_steps,
+                extras={"replay": replay_state, "carry": carry,
+                        "first_call": True},
+            )
+            if include_replay:
+                # re-point the checkpoint closure at the loop-carried ring
+                hooks.extra_state_fn = lambda: {"replay": ls.extras["replay"]}
+
+            def step(ls):
+                ls.key, it_key, hk_key = jax.random.split(ls.key, 3)
+                beta = jnp.asarray(
+                    self._beta(ls.env_steps, total), jnp.float32
+                )
                 warmup = jnp.asarray(
-                    env_steps < self.algo.exploration.warmup_steps
+                    ls.env_steps < self.algo.exploration.warmup_steps
                 )
                 # unfenced dispatch span (see launch/trainer.py's note)
                 with hooks.tracer.span("train_iter"):
-                    state, replay_state, carry, metrics = self._train_iter(
-                        state, replay_state, carry, it_key, beta, warmup,
-                        jnp.asarray(first_call),
+                    (ls.state, ls.extras["replay"], ls.extras["carry"],
+                     metrics) = self._train_iter(
+                        ls.state, ls.extras["replay"], ls.extras["carry"],
+                        it_key, beta, warmup,
+                        jnp.asarray(ls.extras["first_call"]),
                     )
-                first_call = False
-                iteration += 1
-                env_steps += steps_per_iter
-                _, stop = hooks.end_iteration(
-                    iteration, env_steps, state, hk_key, metrics, on_metrics
+                ls.extras["first_call"] = False
+                return Outcome(
+                    metrics=metrics, hook_key=hk_key, steps=steps_per_iter,
                 )
-                if hooks.recovery.pending:
-                    rb = hooks.recovery.rollback(
-                        state, fresh=self._fresh_init,
-                        # replay rides the rollback when it was snapshotted;
-                        # otherwise the buffer is kept — its contents are
-                        # DATA (worst case: some poisoned-policy transitions
-                        # that re-trip the bounded guard), not parameters
-                        extra_template=(
-                            {"replay": replay_state} if include_replay else None
-                        ),
-                    )
-                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
-                    if self.mesh is not None and self.mesh.size > 1:
-                        from surreal_tpu.parallel.mesh import replicate_state
 
-                        state = replicate_state(self.mesh, state)
-                    if rb.extra is not None:
-                        replay_state = rb.extra["replay"]
-                    key = jax.random.fold_in(key, rb.nonce)
-                    carry = self.committed_carry(
-                        jax.random.fold_in(env_key, rb.nonce)
-                    )
-                    # the fresh carry's n-step tail is fabricated again:
-                    # re-scrub the first folded chunk after the rollback
-                    first_call = True
-                    continue
-                if stop:
-                    break
+            def apply_fault(ls, f):
+                ls.state = faults.apply_trainer_fault(f, ls.state)
+
+            def on_rollback(ls):
+                rb = hooks.recovery.rollback(
+                    ls.state, fresh=self._fresh_init,
+                    # replay rides the rollback when it was snapshotted;
+                    # otherwise the buffer is kept — its contents are
+                    # DATA (worst case: some poisoned-policy transitions
+                    # that re-trip the bounded guard), not parameters
+                    extra_template=(
+                        {"replay": ls.extras["replay"]}
+                        if include_replay else None
+                    ),
+                )
+                ls.state, ls.iteration, ls.env_steps = (
+                    rb.state, rb.iteration, rb.env_steps
+                )
+                if self.mesh is not None and self.mesh.size > 1:
+                    from surreal_tpu.parallel.mesh import replicate_state
+
+                    ls.state = replicate_state(self.mesh, ls.state)
+                if rb.extra is not None:
+                    ls.extras["replay"] = rb.extra["replay"]
+                ls.key = jax.random.fold_in(ls.key, rb.nonce)
+                ls.extras["carry"] = self.committed_carry(
+                    jax.random.fold_in(env_key, rb.nonce)
+                )
+                # the fresh carry's n-step tail is fabricated again:
+                # re-scrub the first folded chunk after the rollback
+                ls.extras["first_call"] = True
+
+            engine = LoopEngine(
+                hooks, total, step, stages, engine_cfg,
+                on_metrics=on_metrics, apply_fault=apply_fault,
+                on_rollback=on_rollback,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:
@@ -760,109 +807,144 @@ class OffPolicyTrainer:
             with hooks.tracer.span("h2d-transfer"):
                 return jax.device_put(traj), chunk_returns
 
-        overlap = bool(
-            self.config.session_config.topology.get("overlap_rollouts", True)
-        )
+        overlap = overlap_collect(self.config.session_config)
         prefetch = (
             Prefetcher(collect_chunk, name="offpolicy-stage") if overlap else None
         )
         include_replay = bool(
             ckpt_cfg.get("include_replay", False)
         ) and hooks.ckpt is not None
-        first_chunk = True
-        try:
-            while env_steps < total:
-                f = faults.fire("trainer.iteration")
-                if f is not None:
-                    state = faults.apply_trainer_fault(f, state)
-                    act_holder[0] = state
-                if prefetch is not None:
-                    with hooks.tracer.span("chunk-wait"):
-                        traj, ep_returns = prefetch.get()
-                else:
-                    # no chunk-wait span: collect_chunk records its own
-                    # rollout/h2d phases, and wrapping it here would count
-                    # the same wall time twice in the diag breakdown
-                    traj, ep_returns = collect_chunk()
-                recent_returns.extend(ep_returns)
-                if host_tail is not None:
-                    full = jax.tree.map(
-                        lambda a, b: jnp.concatenate([a, b], axis=0), host_tail, traj
-                    )
-                    host_tail = jax.tree.map(
-                        lambda x: x[-(self.algo.n_step - 1):], full
-                    )
-                else:
-                    full = traj
-                trans = self._nstep(full)
-                if host_tail is not None and first_chunk:
-                    # same scrub as the device path: the run's first prepended
-                    # tail is fabricated, so its windows must not enter replay
-                    trans = scrub_fake_prefix_windows(
-                        trans, self.algo.n_step, self.num_envs
-                    )
-                first_chunk = False
-                with hooks.tracer.span("replay-insert"):
-                    replay_state = self._insert(replay_state, trans)
-                state = self.learner.update_obs_stats(state, traj["obs"])
-                if bool(self.replay.can_sample(replay_state)):
-                    beta = jnp.asarray(self._beta(env_steps, total), jnp.float32)
-                    for _ in range(self.algo.updates_per_iter):
-                        key, skey = jax.random.split(key)
-                        with hooks.tracer.span("replay-sample"):
-                            if self.prioritized:
-                                replay_state, batch, info = self._sample(replay_state, skey, beta=beta)
-                                batch = dict(batch, is_weights=info["is_weights"])
-                            else:
-                                replay_state, batch, info = self._sample(replay_state, skey)
-                        with hooks.tracer.span("learn"):
-                            state, metrics = self._learn(state, batch, skey)
-                        # cost accounting, first update only (idempotent;
-                        # needs a representative replay batch to lower)
-                        hooks.record_program_costs(
-                            "learn", self._learn, state, batch, skey,
-                            phase="learn",
-                        )
-                        td_abs = metrics.pop("priority/td_abs")
-                        if self.prioritized:
-                            replay_state = self._update_prio(replay_state, info["idx"], td_abs)
-                    metrics["replay/sample_age_frac"] = self.replay.age_frac(
-                        replay_state, info["idx"]
-                    )
-                else:
-                    metrics = {}
-                metrics = dict(metrics, **self.replay.gauges(replay_state))
-                # publish the updated acting state + consumed-step count to
-                # the staging thread (its next chunk explores with them)
-                act_holder[0] = state
-                iteration += 1
-                env_steps += steps_per_iter
-                steps_holder[0] = env_steps
-                key, hk_key = jax.random.split(key)
-                _, stop = hooks.end_iteration(
-                    iteration, env_steps, state, hk_key,
-                    host_metrics(metrics, recent_returns), on_metrics,
+        # nothing donates on the host path (the staging thread acts from
+        # act_holder[0]); include_replay still pins the boundary inline —
+        # the checkpoint closure reads the live ring (see the device path)
+        stages = (
+            StageSpec("collect", donate=False, overlap=overlap),
+            StageSpec("stage", donate=False, overlap=overlap),
+            StageSpec("learn", donate=False),
+        ) + sideband_stages()
+        engine_cfg = EngineConfig.from_session(self.config.session_config)
+        if include_replay and engine_cfg.pipeline_sidebands:
+            hooks.log.warning(
+                "engine.pipeline_sidebands is pinned off: "
+                "checkpoint.include_replay snapshots the live ring"
+            )
+            engine_cfg = engine_cfg.inline()
+        ls = LoopState(
+            state=state, key=key, iteration=iteration, env_steps=env_steps,
+            extras={"replay": replay_state, "first_chunk": True},
+        )
+        if ckpt_cfg.get("include_replay", False) and hooks.ckpt is not None:
+            # re-point the checkpoint closure at the loop-carried ring
+            hooks.extra_state_fn = lambda: {"replay": ls.extras["replay"]}
+
+        def step(ls):
+            nonlocal host_tail
+            if prefetch is not None:
+                with hooks.tracer.span("chunk-wait"):
+                    traj, ep_returns = prefetch.get()
+            else:
+                # no chunk-wait span: collect_chunk records its own
+                # rollout/h2d phases, and wrapping it here would count
+                # the same wall time twice in the diag breakdown
+                traj, ep_returns = collect_chunk()
+            recent_returns.extend(ep_returns)
+            if host_tail is not None:
+                full = jax.tree.map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0), host_tail, traj
                 )
-                if hooks.recovery.pending:
-                    rb = hooks.recovery.rollback(
-                        state, fresh=self._fresh_init,
-                        extra_template=(
-                            {"replay": replay_state} if include_replay else None
-                        ),
+                host_tail = jax.tree.map(
+                    lambda x: x[-(self.algo.n_step - 1):], full
+                )
+            else:
+                full = traj
+            trans = self._nstep(full)
+            if host_tail is not None and ls.extras["first_chunk"]:
+                # same scrub as the device path: the run's first prepended
+                # tail is fabricated, so its windows must not enter replay
+                trans = scrub_fake_prefix_windows(
+                    trans, self.algo.n_step, self.num_envs
+                )
+            ls.extras["first_chunk"] = False
+            with hooks.tracer.span("replay-insert"):
+                ls.extras["replay"] = self._insert(ls.extras["replay"], trans)
+            ls.state = self.learner.update_obs_stats(ls.state, traj["obs"])
+            if bool(self.replay.can_sample(ls.extras["replay"])):
+                beta = jnp.asarray(
+                    self._beta(ls.env_steps, total), jnp.float32
+                )
+                for _ in range(self.algo.updates_per_iter):
+                    ls.key, skey = jax.random.split(ls.key)
+                    with hooks.tracer.span("replay-sample"):
+                        if self.prioritized:
+                            ls.extras["replay"], batch, info = self._sample(
+                                ls.extras["replay"], skey, beta=beta
+                            )
+                            batch = dict(batch, is_weights=info["is_weights"])
+                        else:
+                            ls.extras["replay"], batch, info = self._sample(
+                                ls.extras["replay"], skey
+                            )
+                    with hooks.tracer.span("learn"):
+                        ls.state, metrics = self._learn(ls.state, batch, skey)
+                    # cost accounting, first update only (idempotent;
+                    # needs a representative replay batch to lower)
+                    hooks.record_program_costs(
+                        "learn", self._learn, ls.state, batch, skey,
+                        phase="learn",
                     )
-                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
-                    if rb.extra is not None:
-                        replay_state = rb.extra["replay"]
-                    # staging thread keeps collecting: hand it the restored
-                    # acting state + rolled-back step count; chunks already
-                    # staged from the poisoned policy are data the replay
-                    # (and the bounded guard) absorb
-                    act_holder[0] = state
-                    steps_holder[0] = env_steps
-                    key = jax.random.fold_in(key, rb.nonce)
-                    continue
-                if stop:
-                    break
+                    td_abs = metrics.pop("priority/td_abs")
+                    if self.prioritized:
+                        ls.extras["replay"] = self._update_prio(
+                            ls.extras["replay"], info["idx"], td_abs
+                        )
+                metrics["replay/sample_age_frac"] = self.replay.age_frac(
+                    ls.extras["replay"], info["idx"]
+                )
+            else:
+                metrics = {}
+            metrics = dict(metrics, **self.replay.gauges(ls.extras["replay"]))
+            # publish the updated acting state + consumed-step count to
+            # the staging thread (its next chunk explores with them)
+            act_holder[0] = ls.state
+            steps_holder[0] = ls.env_steps + steps_per_iter
+            ls.key, hk_key = jax.random.split(ls.key)
+            return Outcome(
+                metrics=host_metrics(metrics, recent_returns),
+                hook_key=hk_key, steps=steps_per_iter,
+            )
+
+        def apply_fault(ls, f):
+            ls.state = faults.apply_trainer_fault(f, ls.state)
+            act_holder[0] = ls.state
+
+        def on_rollback(ls):
+            rb = hooks.recovery.rollback(
+                ls.state, fresh=self._fresh_init,
+                extra_template=(
+                    {"replay": ls.extras["replay"]} if include_replay else None
+                ),
+            )
+            ls.state, ls.iteration, ls.env_steps = (
+                rb.state, rb.iteration, rb.env_steps
+            )
+            if rb.extra is not None:
+                ls.extras["replay"] = rb.extra["replay"]
+            # staging thread keeps collecting: hand it the restored
+            # acting state + rolled-back step count; chunks already
+            # staged from the poisoned policy are data the replay
+            # (and the bounded guard) absorb
+            act_holder[0] = ls.state
+            steps_holder[0] = ls.env_steps
+            ls.key = jax.random.fold_in(ls.key, rb.nonce)
+
+        try:
+            engine = LoopEngine(
+                hooks, total, step, stages, engine_cfg,
+                on_metrics=on_metrics, apply_fault=apply_fault,
+                on_rollback=on_rollback,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:
@@ -1070,107 +1152,120 @@ class OffPolicyTrainer:
                 tiered.append(dict(trans))
             return wm, traj["obs"], chunk_returns
 
-        overlap = bool(
-            self.config.session_config.topology.get("overlap_rollouts", True)
-        )
+        overlap = overlap_collect(self.config.session_config)
         prefetch = (
             Prefetcher(collect_and_send, name="offpolicy-xp-stage")
             if overlap else None
         )
-        pending_jobs = 0
-        try:
-            while env_steps < total:
-                f = faults.fire("trainer.iteration")
-                if f is not None:
-                    state = faults.apply_trainer_fault(f, state)
-                    act_holder[0] = state
-                # consume the batches prefetched during the PREVIOUS
-                # iteration's learn drain (zero-wait in the steady state —
-                # the sample-wait span/gauge measures the residue). This
-                # runs BEFORE the next chunk is sent in strict mode, which
-                # is exactly what makes the record deterministic: the
-                # shard serves every watermarked sample at the precise
-                # ring state the watermark names.
-                staged = None
-                if pending_jobs:
-                    with hooks.tracer.span("sample-wait"):
-                        staged = sampler.get_iteration()
-                    pending_jobs -= 1
-                if prefetch is not None:
-                    with hooks.tracer.span("chunk-wait"):
-                        wm, obs_chunk, ep_returns = prefetch.get()
-                else:
-                    wm, obs_chunk, ep_returns = collect_and_send()
-                recent_returns.extend(ep_returns)
-                state = self.learner.update_obs_stats(state, obs_chunk)
-                if sum(wm) >= int(replay_cfg.start_sample_size):
-                    sampler.request_iteration(
-                        wm, self._beta(env_steps, total)
-                    )
-                    pending_jobs += 1
-                metrics = {}
-                if staged:
-                    infos, tds = [], []
-                    for batch, skey, info in staged:
-                        with hooks.tracer.span("learn"):
-                            if group is not None:
-                                state, metrics = group.learn(
-                                    state, batch, skey
-                                )
-                            else:
-                                state, metrics = self._learn(
-                                    state, batch, skey
-                                )
-                                hooks.record_program_costs(
-                                    "learn", self._learn, state, batch,
-                                    skey, phase="learn",
-                                )
-                        td_abs = metrics.pop("priority/td_abs")
-                        infos.append(info)
-                        tds.append(np.asarray(td_abs))
-                    if self.prioritized:
-                        # ONE batched priority frame per shard per
-                        # iteration (the sample_many discipline on-wire)
-                        sampler.update_priorities(infos, tds)
-                plane.supervise()
-                if group is not None:
-                    group.supervise()
-                act_holder[0] = state
-                iteration += 1
-                env_steps += steps_per_iter
-                steps_holder[0] = env_steps
-                key, hk_key = jax.random.split(key)
-                base_build = host_metrics(metrics, recent_returns)
+        pending_jobs = [0]
+        stages = (
+            StageSpec("collect", donate=False, overlap=overlap),
+            StageSpec("stage", donate=False, overlap=overlap),
+            StageSpec("learn", donate=False),
+        ) + sideband_stages()
+        ls = LoopState(
+            state=state, key=key, iteration=iteration, env_steps=env_steps,
+        )
 
-                def build_metrics(base=base_build):
-                    # plane.gauges() polls shard stats over the wire —
-                    # deferred into the metrics callable so it runs only
-                    # when the cadence fires
-                    row = dict(base(), **plane.gauges())
-                    if group is not None:
-                        row.update(group.gauges())
-                    return row
-
-                m_row, stop = hooks.end_iteration(
-                    iteration, env_steps, state, hk_key, build_metrics,
-                    on_metrics,
+        def step(ls):
+            # consume the batches prefetched during the PREVIOUS
+            # iteration's learn drain (zero-wait in the steady state —
+            # the sample-wait span/gauge measures the residue). This
+            # runs BEFORE the next chunk is sent in strict mode, which
+            # is exactly what makes the record deterministic: the
+            # shard serves every watermarked sample at the precise
+            # ring state the watermark names.
+            staged = None
+            if pending_jobs[0]:
+                with hooks.tracer.span("sample-wait"):
+                    staged = sampler.get_iteration()
+                pending_jobs[0] -= 1
+            if prefetch is not None:
+                with hooks.tracer.span("chunk-wait"):
+                    wm, obs_chunk, ep_returns = prefetch.get()
+            else:
+                wm, obs_chunk, ep_returns = collect_and_send()
+            recent_returns.extend(ep_returns)
+            ls.state = self.learner.update_obs_stats(ls.state, obs_chunk)
+            if sum(wm) >= int(replay_cfg.start_sample_size):
+                sampler.request_iteration(
+                    wm, self._beta(ls.env_steps, total)
                 )
-                if m_row is not None:
-                    hooks.experience_event(**plane.telemetry_event())
-                if hooks.recovery.pending:
-                    rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
-                    state, iteration, env_steps = (
-                        rb.state, rb.iteration, rb.env_steps
-                    )
-                    # shard contents are DATA (same rationale as the
-                    # in-process rollback path); the restored state re-arms
-                    # acting and the key chain re-seeds
-                    act_holder[0] = state
-                    steps_holder[0] = env_steps
-                    key = jax.random.fold_in(key, rb.nonce)
-                    continue
-                if stop:
-                    break
+                pending_jobs[0] += 1
+            metrics = {}
+            if staged:
+                infos, tds = [], []
+                for batch, skey, info in staged:
+                    with hooks.tracer.span("learn"):
+                        if group is not None:
+                            ls.state, metrics = group.learn(
+                                ls.state, batch, skey
+                            )
+                        else:
+                            ls.state, metrics = self._learn(
+                                ls.state, batch, skey
+                            )
+                            hooks.record_program_costs(
+                                "learn", self._learn, ls.state, batch,
+                                skey, phase="learn",
+                            )
+                    td_abs = metrics.pop("priority/td_abs")
+                    infos.append(info)
+                    tds.append(np.asarray(td_abs))
+                if self.prioritized:
+                    # ONE batched priority frame per shard per
+                    # iteration (the sample_many discipline on-wire)
+                    sampler.update_priorities(infos, tds)
+            plane.supervise()
+            if group is not None:
+                group.supervise()
+            act_holder[0] = ls.state
+            steps_holder[0] = ls.env_steps + steps_per_iter
+            ls.key, hk_key = jax.random.split(ls.key)
+            base_build = host_metrics(metrics, recent_returns)
+
+            def build_metrics(base=base_build):
+                # plane.gauges() polls shard stats over the wire —
+                # deferred into the metrics callable so it runs only
+                # when the cadence fires
+                row = dict(base(), **plane.gauges())
+                if group is not None:
+                    row.update(group.gauges())
+                return row
+
+            return Outcome(
+                metrics=build_metrics, hook_key=hk_key,
+                steps=steps_per_iter,
+                post_metrics=lambda m_row: hooks.experience_event(
+                    **plane.telemetry_event()
+                ),
+            )
+
+        def apply_fault(ls, f):
+            ls.state = faults.apply_trainer_fault(f, ls.state)
+            act_holder[0] = ls.state
+
+        def on_rollback(ls):
+            rb = hooks.recovery.rollback(ls.state, fresh=self._fresh_init)
+            ls.state, ls.iteration, ls.env_steps = (
+                rb.state, rb.iteration, rb.env_steps
+            )
+            # shard contents are DATA (same rationale as the
+            # in-process rollback path); the restored state re-arms
+            # acting and the key chain re-seeds
+            act_holder[0] = ls.state
+            steps_holder[0] = ls.env_steps
+            ls.key = jax.random.fold_in(ls.key, rb.nonce)
+
+        try:
+            engine = LoopEngine(
+                hooks, total, step, stages,
+                EngineConfig.from_session(self.config.session_config),
+                on_metrics=on_metrics, apply_fault=apply_fault,
+                on_rollback=on_rollback,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:

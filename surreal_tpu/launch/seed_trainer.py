@@ -33,6 +33,14 @@ from typing import Callable
 import jax
 import numpy as np
 
+from surreal_tpu.engine import (
+    EngineConfig,
+    LoopEngine,
+    LoopState,
+    Outcome,
+    StageSpec,
+    sideband_stages,
+)
 from surreal_tpu.distributed.env_worker import run_env_worker
 from surreal_tpu.distributed.inference_server import InferenceServer
 from surreal_tpu.learners import build_learner
@@ -860,10 +868,19 @@ class SEEDTrainer:
                     **(server.episode_stats() or {}),
                 }
 
-            while env_steps < total:
-                f = faults.fire("trainer.iteration")
-                if f is not None:
-                    state = faults.apply_trainer_fault(f, state)
+            # the SEED collect stage is ALWAYS overlapped: workers stream
+            # chunks into the server queue regardless of the engine knob
+            stages = (
+                StageSpec("collect", donate=False, overlap=True),
+                StageSpec("learn", donate=False),
+            ) + sideband_stages()
+            ls = LoopState(
+                state=state, key=key, iteration=iteration,
+                env_steps=env_steps,
+            )
+
+            def step(ls):
+                nonlocal dropped_stale, discarded_steps, dp_event_emitted
                 with hooks.tracer.span("chunk-wait"):
                     batch, versions, n_steps, lineage, exemplar = (
                         prefetch.get()
@@ -883,20 +900,21 @@ class SEEDTrainer:
                     # workers (a streak of stale chunks must not pause
                     # respawn or stretch wall-clock past the step budget).
                     # The prefetcher already paid this chunk's transfer —
-                    # a bounded waste (drops are the exception path).
+                    # a bounded waste (drops are the exception path). The
+                    # engine's skip path counts the steps, runs no
+                    # boundary, and still honors the interrupt latch (a
+                    # preemption must not sit out a stale streak).
                     dropped_stale += 1
-                    env_steps += n_steps
                     discarded_steps += n_steps
                     plane.supervise()
-                    if hooks.interrupted:
-                        # this path never reaches end_iteration's stop —
-                        # a preemption must not sit out a stale streak
-                        break
-                    continue
-                key, lkey, hk_key = jax.random.split(key, 3)
+                    return Outcome(
+                        metrics=None, hook_key=None, steps=n_steps,
+                        skip_boundary=True,
+                    )
+                ls.key, lkey, hk_key = jax.random.split(ls.key, 3)
                 t_learn0 = time.perf_counter()
                 with hooks.tracer.span("learn"):
-                    state, metrics = self._learn(state, batch, lkey)
+                    ls.state, metrics = self._learn(ls.state, batch, lkey)
                 learn_ms.append((time.perf_counter() - t_learn0) * 1e3)
                 if exemplar is not None:
                     # the adopted exemplar's final hop: THIS learn step
@@ -916,12 +934,13 @@ class SEEDTrainer:
                 # cost accounting, first learn only (idempotent; needs a
                 # representative staged chunk to lower)
                 hooks.record_program_costs(
-                    "learn", self._learn, state, batch, lkey, phase="learn"
+                    "learn", self._learn, ls.state, batch, lkey,
+                    phase="learn",
                 )
                 with hooks.tracer.span("param-publish"):
-                    server.set_act_fn(self._make_act_fn(state, key_holder))
-                iteration += 1
-                env_steps += n_steps
+                    server.set_act_fn(
+                        self._make_act_fn(ls.state, key_holder)
+                    )
                 plane.supervise()
                 if gateway is not None:
                     gateway.supervise()
@@ -952,14 +971,13 @@ class SEEDTrainer:
                     ),
                     **data_plane_extras(),
                     # cached (last-cadence) plane gauges: the wire poll
-                    # happens below at the cadence, not per iteration
+                    # happens at the cadence (post_metrics), not per
+                    # iteration
                     **(xplane.gauges(poll=False) if xplane is not None else {}),
                     **(gateway.gauges() if gateway is not None else {}),
                 )
-                m_row, stop_flag = hooks.end_iteration(
-                    iteration, env_steps, state, hk_key, metrics, on_metrics
-                )
-                if m_row is not None:
+
+                def post_metrics(m_row):
                     # per-hop latency percentiles ride the metrics cadence
                     # (host-side deques only — no device work)
                     hooks.tracer.event(
@@ -976,21 +994,38 @@ class SEEDTrainer:
                     if xplane is not None:
                         xplane._poll_stats()
                         hooks.experience_event(**xplane.telemetry_event())
-                if hooks.recovery.pending:
-                    rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
-                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
-                    if self.mesh is not None:
-                        from surreal_tpu.parallel.mesh import replicate_state
 
-                        state = replicate_state(self.mesh, state)
-                    # the live act closure aliases the poisoned state:
-                    # re-arm acting from the restored one immediately (the
-                    # version bump also marks in-flight chunks stale)
-                    server.set_act_fn(self._make_act_fn(state, key_holder))
-                    key = jax.random.fold_in(key, rb.nonce)
-                    continue
-                if stop_flag:
-                    break
+                return Outcome(
+                    metrics=metrics, hook_key=hk_key, steps=n_steps,
+                    post_metrics=post_metrics,
+                )
+
+            def apply_fault(ls, f):
+                ls.state = faults.apply_trainer_fault(f, ls.state)
+
+            def on_rollback(ls):
+                rb = hooks.recovery.rollback(ls.state, fresh=self._fresh_init)
+                ls.state, ls.iteration, ls.env_steps = (
+                    rb.state, rb.iteration, rb.env_steps
+                )
+                if self.mesh is not None:
+                    from surreal_tpu.parallel.mesh import replicate_state
+
+                    ls.state = replicate_state(self.mesh, ls.state)
+                # the live act closure aliases the poisoned state:
+                # re-arm acting from the restored one immediately (the
+                # version bump also marks in-flight chunks stale)
+                server.set_act_fn(self._make_act_fn(ls.state, key_holder))
+                ls.key = jax.random.fold_in(ls.key, rb.nonce)
+
+            engine = LoopEngine(
+                hooks, total, step, stages,
+                EngineConfig.from_session(self.config.session_config),
+                on_metrics=on_metrics, apply_fault=apply_fault,
+                on_rollback=on_rollback,
+            )
+            ls = engine.run(ls)
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
             # the drop path consumes budget without firing the metrics
             # cadence; reconcile the trailing snapshot with reality (only
             # when it actually trails — an unconditional flush would

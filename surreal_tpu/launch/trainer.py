@@ -23,6 +23,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from surreal_tpu.engine import (
+    EngineConfig,
+    LoopEngine,
+    LoopState,
+    Outcome,
+    StageSpec,
+    overlap_collect,
+    sideband_stages,
+)
 from surreal_tpu.envs import is_jax_env, make_env
 from surreal_tpu.launch.hooks import SessionHooks, host_metrics, training_env_config
 from surreal_tpu.launch.rollout import (
@@ -273,48 +282,70 @@ class Trainer:
                     "train_iter", self._train_iter, state, carry,
                     jax.random.fold_in(key, 0), phase="train_iter",
                 )
-                while env_steps < total:
-                    f = faults.fire("trainer.iteration")
-                    if f is not None:
-                        state = faults.apply_trainer_fault(f, state)
-                    key, it_key, hk_key = jax.random.split(key, 3)
+                # the fused iteration donates state+carry, so a DEFERRED
+                # boundary reads a jnp.copy snapshot (engine/core.py)
+                stages = (
+                    StageSpec("collect", donate=True),
+                    StageSpec("learn", donate=True),
+                ) + sideband_stages()
+
+                def step(ls):
+                    ls.key, it_key, hk_key = jax.random.split(ls.key, 3)
                     # span is UNFENCED (dispatch time): fencing here would
                     # serialize the async pipeline; window totals are
                     # honest under backpressure and the cadence sync in
                     # end_iteration is the real fence (session/telemetry.py)
                     with hooks.tracer.span("train_iter"):
-                        state, carry, metrics = self._train_iter(
-                            state, carry, it_key
+                        ls.state, ls.extras["carry"], metrics = (
+                            self._train_iter(
+                                ls.state, ls.extras["carry"], it_key
+                            )
                         )
-                    iteration += 1
-                    env_steps += steps_per_iter
-                    _, stop = hooks.end_iteration(
-                        iteration, env_steps, state, hk_key, metrics, on_metrics
+                    return Outcome(
+                        metrics=metrics, hook_key=hk_key,
+                        steps=steps_per_iter,
                     )
-                    if hooks.recovery.pending:
-                        rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
-                        state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
-                        if self.mesh is not None and self.mesh.size > 1:
-                            from surreal_tpu.parallel.mesh import replicate_state
 
-                            state = replicate_state(self.mesh, state)
-                        # re-seed the offending batch: roll the key chain
-                        # and the env carry so a deterministic workload
-                        # cannot replay into the same divergence
-                        key = jax.random.fold_in(key, rb.nonce)
-                        carry = self.init_loop_state(
-                            jax.random.fold_in(env_key, rb.nonce)
-                        )
-                        continue
-                    if stop:
-                        break
-            else:
-                overlap = bool(
-                    self.config.session_config.topology.get(
-                        "overlap_rollouts", True
+                def apply_fault(ls, f):
+                    ls.state = faults.apply_trainer_fault(f, ls.state)
+
+                def on_rollback(ls):
+                    rb = hooks.recovery.rollback(
+                        ls.state, fresh=self._fresh_init
                     )
+                    ls.state, ls.iteration, ls.env_steps = (
+                        rb.state, rb.iteration, rb.env_steps
+                    )
+                    if self.mesh is not None and self.mesh.size > 1:
+                        from surreal_tpu.parallel.mesh import replicate_state
+
+                        ls.state = replicate_state(self.mesh, ls.state)
+                    # re-seed the offending batch: roll the key chain
+                    # and the env carry so a deterministic workload
+                    # cannot replay into the same divergence
+                    ls.key = jax.random.fold_in(ls.key, rb.nonce)
+                    ls.extras["carry"] = self.init_loop_state(
+                        jax.random.fold_in(env_key, rb.nonce)
+                    )
+
+                engine = LoopEngine(
+                    hooks, total, step, stages,
+                    EngineConfig.from_session(cfg),
+                    on_metrics=on_metrics, apply_fault=apply_fault,
+                    on_rollback=on_rollback,
                 )
-                loop = self._host_loop_overlap if overlap else self._host_loop_alternate
+                ls = engine.run(LoopState(
+                    state=state, key=key, iteration=iteration,
+                    env_steps=env_steps, extras={"carry": carry},
+                ))
+                state, iteration, env_steps = (
+                    ls.state, ls.iteration, ls.env_steps
+                )
+            else:
+                loop = (
+                    self._host_loop_overlap if overlap_collect(cfg)
+                    else self._host_loop_alternate
+                )
                 state, iteration, env_steps = loop(
                     state, iteration, env_steps, total, key, hooks, on_metrics
                 )
@@ -335,51 +366,67 @@ class Trainer:
         from surreal_tpu.launch.hooks import HOST_METRICS_WINDOW
 
         steps_per_iter = self.horizon * self.num_envs
-        obs = self.env.reset(seed=self.config.env_config.seed)
+        obs_holder = [self.env.reset(seed=self.config.env_config.seed)]
         recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
-        while env_steps < total:
-            f = faults.fire("trainer.iteration")
-            if f is not None:
-                state = faults.apply_trainer_fault(f, state)
-            key, r_key, l_key, hk_key = jax.random.split(key, 4)
+        # host path: nothing donates (acting reuses the state every env
+        # step), so a deferred boundary version-pins the state reference
+        stages = (
+            StageSpec("collect", donate=False),
+            StageSpec("learn", donate=False),
+        ) + sideband_stages()
+
+        def step(ls):
+            ls.key, r_key, l_key, hk_key = jax.random.split(ls.key, 4)
             with hooks.tracer.span("rollout"):
-                obs, batch, ep_stats = host_rollout(
-                    self.env, self._act, state, obs, r_key, self.horizon
+                obs_holder[0], batch, ep_stats = host_rollout(
+                    self.env, self._act, ls.state, obs_holder[0], r_key,
+                    self.horizon,
                 )
             with hooks.tracer.span("learn"):
-                state, metrics = self._learn(state, batch, l_key)
+                ls.state, metrics = self._learn(ls.state, batch, l_key)
             # cost accounting, first iteration only (idempotent): the
             # learn program needs a representative batch to lower, and
             # the act program runs horizon times inside each 'rollout'
             # phase (its MFU contribution is a documented lower bound —
             # the phase also times env stepping)
             hooks.record_program_costs(
-                "learn", self._learn, state, batch, l_key, phase="learn"
+                "learn", self._learn, ls.state, batch, l_key, phase="learn"
             )
             hooks.record_program_costs(
-                "act", self._act, state, batch["obs"][0], l_key,
+                "act", self._act, ls.state, batch["obs"][0], l_key,
                 phase="rollout", calls_per_phase=self.horizon,
             )
-            iteration += 1
-            env_steps += steps_per_iter
             recent_returns.extend(ep_stats["returns"])
-            _, stop = hooks.end_iteration(
-                iteration, env_steps, state, hk_key,
-                host_metrics(metrics, recent_returns), on_metrics,
+            return Outcome(
+                metrics=host_metrics(metrics, recent_returns),
+                hook_key=hk_key, steps=steps_per_iter,
             )
-            if hooks.recovery.pending:
-                rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
-                state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
-                key = jax.random.fold_in(key, rb.nonce)
-                # a NaN policy steps the env into garbage: reset it on a
-                # nonce-distinct seed (the re-seeded offending batch)
-                obs = self.env.reset(
-                    seed=self.config.env_config.seed + rb.nonce
-                )
-                continue
-            if stop:
-                break
-        return state, iteration, env_steps
+
+        def apply_fault(ls, f):
+            ls.state = faults.apply_trainer_fault(f, ls.state)
+
+        def on_rollback(ls):
+            rb = hooks.recovery.rollback(ls.state, fresh=self._fresh_init)
+            ls.state, ls.iteration, ls.env_steps = (
+                rb.state, rb.iteration, rb.env_steps
+            )
+            ls.key = jax.random.fold_in(ls.key, rb.nonce)
+            # a NaN policy steps the env into garbage: reset it on a
+            # nonce-distinct seed (the re-seeded offending batch)
+            obs_holder[0] = self.env.reset(
+                seed=self.config.env_config.seed + rb.nonce
+            )
+
+        engine = LoopEngine(
+            hooks, total, step, stages,
+            EngineConfig.from_session(self.config.session_config),
+            on_metrics=on_metrics, apply_fault=apply_fault,
+            on_rollback=on_rollback,
+        )
+        ls = engine.run(LoopState(
+            state=state, key=key, iteration=iteration, env_steps=env_steps,
+        ))
+        return ls.state, ls.iteration, ls.env_steps
 
     def _host_loop_overlap(
         self, state, iteration, env_steps, total, key, hooks, on_metrics
@@ -432,54 +479,73 @@ class Trainer:
         collector = threading.Thread(target=collect, daemon=True)
         collector.start()
         recent_returns = deque(maxlen=HOST_METRICS_WINDOW)
+        # overlap=True is the rollout/learn-overlap bit that used to be
+        # the topology.overlap_rollouts fork; nothing donates (the
+        # collector acts from act_state[0] — the very state a donating
+        # learn would invalidate mid-rollout)
+        stages = (
+            StageSpec("collect", donate=False, overlap=True),
+            StageSpec("learn", donate=False),
+        ) + sideband_stages()
+
+        def step(ls):
+            with tracer.span("chunk-wait"):
+                got = out.get()
+            if isinstance(got, BaseException):
+                raise got
+            batch, ep_stats = got
+            ls.key, l_key, hk_key = jax.random.split(ls.key, 3)
+            with tracer.span("learn"):
+                ls.state, metrics = self._learn(ls.state, batch, l_key)
+            act_state[0] = ls.state  # device-resident; no host copy
+            # cost accounting, first iteration only (see the
+            # alternation loop's note)
+            hooks.record_program_costs(
+                "learn", self._learn, ls.state, batch, l_key, phase="learn"
+            )
+            hooks.record_program_costs(
+                "act", self._act, ls.state, batch["obs"][0], l_key,
+                phase="rollout", calls_per_phase=self.horizon,
+            )
+            recent_returns.extend(ep_stats["returns"])
+            return Outcome(
+                metrics=host_metrics(metrics, recent_returns),
+                hook_key=hk_key, steps=steps_per_iter,
+            )
+
+        def apply_fault(ls, f):
+            ls.state = faults.apply_trainer_fault(f, ls.state)
+            act_state[0] = ls.state
+
+        def on_rollback(ls):
+            rb = hooks.recovery.rollback(ls.state, fresh=self._fresh_init)
+            ls.state, ls.iteration, ls.env_steps = (
+                rb.state, rb.iteration, rb.env_steps
+            )
+            act_state[0] = ls.state  # collector acts healthy again
+            ls.key = jax.random.fold_in(ls.key, rb.nonce)
+            # drop any queued rollout collected by the poisoned
+            # policy (data, not params — but no reason to learn on
+            # it); the collector's own env obs cannot be reset from
+            # here, so a run whose ENV state went nonfinite re-trips
+            # and exhausts the bounded budget loudly
+            try:
+                out.get_nowait()
+            except queue_mod.Empty:
+                pass
+
         try:
-            while env_steps < total:
-                f = faults.fire("trainer.iteration")
-                if f is not None:
-                    state = faults.apply_trainer_fault(f, state)
-                    act_state[0] = state
-                with tracer.span("chunk-wait"):
-                    got = out.get()
-                if isinstance(got, BaseException):
-                    raise got
-                batch, ep_stats = got
-                key, l_key, hk_key = jax.random.split(key, 3)
-                with tracer.span("learn"):
-                    state, metrics = self._learn(state, batch, l_key)
-                act_state[0] = state  # device-resident; no host copy
-                # cost accounting, first iteration only (see the
-                # alternation loop's note)
-                hooks.record_program_costs(
-                    "learn", self._learn, state, batch, l_key, phase="learn"
-                )
-                hooks.record_program_costs(
-                    "act", self._act, state, batch["obs"][0], l_key,
-                    phase="rollout", calls_per_phase=self.horizon,
-                )
-                iteration += 1
-                env_steps += steps_per_iter
-                recent_returns.extend(ep_stats["returns"])
-                _, stop = hooks.end_iteration(
-                    iteration, env_steps, state, hk_key,
-                    host_metrics(metrics, recent_returns), on_metrics,
-                )
-                if hooks.recovery.pending:
-                    rb = hooks.recovery.rollback(state, fresh=self._fresh_init)
-                    state, iteration, env_steps = rb.state, rb.iteration, rb.env_steps
-                    act_state[0] = state  # collector acts healthy again
-                    key = jax.random.fold_in(key, rb.nonce)
-                    # drop any queued rollout collected by the poisoned
-                    # policy (data, not params — but no reason to learn on
-                    # it); the collector's own env obs cannot be reset from
-                    # here, so a run whose ENV state went nonfinite re-trips
-                    # and exhausts the bounded budget loudly
-                    try:
-                        out.get_nowait()
-                    except queue_mod.Empty:
-                        pass
-                    continue
-                if stop:
-                    break
+            engine = LoopEngine(
+                hooks, total, step, stages,
+                EngineConfig.from_session(self.config.session_config),
+                on_metrics=on_metrics, apply_fault=apply_fault,
+                on_rollback=on_rollback,
+            )
+            ls = engine.run(LoopState(
+                state=state, key=key, iteration=iteration,
+                env_steps=env_steps,
+            ))
+            state, iteration, env_steps = ls.state, ls.iteration, ls.env_steps
         finally:
             stop_evt.set()
             while True:  # unblock a collector waiting on the full queue

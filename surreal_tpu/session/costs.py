@@ -367,6 +367,25 @@ GAUGE_REGISTRY = {
     "tier/torn_segments": _g("count",
         'torn WAL segments skipped by magic-resync on read (crash '
         'mid-append; the experience.spill chaos site drives this).'),
+    # ---- loop engine (engine/core.py, ISSUE 19) ----
+    "engine/stage_p50_ms": _g("ms",
+        'median deferred-boundary duration (publish/checkpoint/observe '
+        'side-bands + metrics materialization), last 512 boundaries.'),
+    "engine/stage_p99_ms": _g("ms",
+        'p99 deferred-boundary duration over the same window.'),
+    "engine/occupancy": _g("ratio",
+        'staging-worker busy fraction of wall time while pipelining — '
+        'the off-critical-path work actually reclaimed.'),
+    "engine/queue_depth": _g("count",
+        'deferred boundaries in flight (bounded at 1: one pending slot).'),
+    "engine/deferred_boundaries": _g("count",
+        'boundaries submitted to the staging executor this run.'),
+    "engine/skipped_boundaries": _g("count",
+        'boundaries skipped because the previous one wedged past '
+        'stage_timeout_s (never silent — warned and counted).'),
+    "engine/stage_kills": _g("count",
+        'engine.stage kill_stage chaos firings absorbed by the boundary '
+        '(the stage crashed; training continued).'),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

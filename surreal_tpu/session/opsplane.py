@@ -450,6 +450,7 @@ def top_report(snap: dict | None, folder: str | None = None) -> str:
     reusing diag's section renderers over the snapshot's tier bodies
     instead of a full event-log replay."""
     from surreal_tpu.session.telemetry import (
+        _engine_lines,
         _experience_plane_lines,
         _gateway_lines,
         _performance_lines,
@@ -505,6 +506,10 @@ def top_report(snap: dict | None, folder: str | None = None) -> str:
         lines.append("  (no tier has pushed a row yet)")
     lines += _slo_lines(snap)
     # diag's renderers, fed from the snapshot's tier bodies
+    eng_body = (tiers.get("engine") or {}).get("body")
+    eng_lines = _engine_lines({"engine": eng_body}) if eng_body else []
+    if eng_lines:
+        lines += ["", "Loop engine"] + eng_lines
     gw_body = (tiers.get("gateway") or {}).get("body")
     gw_lines = _gateway_lines({"gateway": gw_body}) if gw_body else []
     if gw_lines:

@@ -210,6 +210,9 @@ EVENT_REGISTRY = {
     "learner_group": "elastic learner-group membership transitions "
                      "(parallel/learner_group.py via "
                      "SessionHooks.learner_group_event)",
+    "engine": "loop-engine stage snapshot: declared stages, boundary/step "
+              "latency percentiles, staging occupancy, deferred/skipped/"
+              "killed boundary counters (engine/core.py, metrics cadence)",
 }
 
 
@@ -661,6 +664,7 @@ def diag_summary(folder: str) -> dict | None:
     experience = None
     serving = None
     gateway = None
+    engine = None
     trace_id = None
     programs: dict[str, dict] = {}   # program_cost events (last per name)
     precision = None                 # last 'precision' event (active policy)
@@ -724,6 +728,12 @@ def diag_summary(folder: str) -> dict | None:
             # the last event is the settled tenant picture (one per
             # metrics row while the session gateway is live)
             gateway = {
+                k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
+            }
+        elif ev.get("type") == "engine":
+            # the last event is the settled loop-engine picture (one per
+            # metrics row; counters are cumulative)
+            engine = {
                 k: v for k, v in ev.items() if k not in ("type", "t", "trace", "seq")
             }
         elif ev.get("type") == "tune":
@@ -840,6 +850,7 @@ def diag_summary(folder: str) -> dict | None:
         "experience": experience,
         "serving": serving,
         "gateway": gateway,
+        "engine": engine,
         "tune": tune,
         "tune_hits": tune_hits,
         "tune_misses": tune_misses,
@@ -915,6 +926,9 @@ def diag_report(folder: str) -> str | None:
             "Data plane — "
             + ", ".join(f"{k}={dpl[k]}" for k in sorted(dpl)),
         ]
+    eng_lines = _engine_lines(s)
+    if eng_lines:
+        lines += ["", "Loop engine"] + eng_lines
     tier_lines = _serving_tier_lines(s)
     if tier_lines:
         lines += ["", "Serving tier"] + tier_lines
@@ -1040,6 +1054,50 @@ def diag_report(folder: str) -> str | None:
         lines += ["", "Incidents (surreal_tpu why for the full report)"]
         lines += inc_lines
     return "\n".join(lines)
+
+
+def _engine_lines(s: dict) -> list[str]:
+    """The diag 'Loop engine' section: declared stage table (donate /
+    deferrable / overlap bits), boundary + step latency percentiles,
+    staging occupancy, and the deferred/skipped/killed boundary counters
+    from the last ``engine`` event. Empty list when the session predates
+    the engine (no event recorded)."""
+    eng = s.get("engine")
+    if not eng:
+        return []
+    lines = [
+        "  pipelined={p} — {d} boundaries deferred, {sk} skipped "
+        "(wedged past the stage bound), {k} stage kills".format(
+            p=bool(eng.get("pipelined")),
+            d=int(eng.get("deferred", 0)),
+            sk=int(eng.get("skipped", 0)),
+            k=int(eng.get("kills", 0)),
+        ),
+    ]
+    st = eng.get("stage_ms") or {}
+    sp = eng.get("step_ms") or {}
+    if st or sp:
+        lines.append(
+            "  boundary p50/p99 {a:.2f}/{b:.2f} ms, step p50/p99 "
+            "{c:.2f}/{d:.2f} ms, staging occupancy {o:.1%}".format(
+                a=float(st.get("p50", 0.0)), b=float(st.get("p99", 0.0)),
+                c=float(sp.get("p50", 0.0)), d=float(sp.get("p99", 0.0)),
+                o=float(eng.get("occupancy", 0.0)),
+            )
+        )
+    stages = eng.get("stages") or []
+    if stages:
+        lines.append(
+            f"  {'stage':<12} {'donate':>7} {'deferrable':>11} {'overlap':>8}"
+        )
+        for spec in stages:
+            lines.append(
+                f"  {str(spec.get('name', '?')):<12} "
+                f"{str(bool(spec.get('donate'))):>7} "
+                f"{str(bool(spec.get('deferrable'))):>11} "
+                f"{str(bool(spec.get('overlap'))):>8}"
+            )
+    return lines
 
 
 def _serving_tier_lines(s: dict) -> list[str]:

@@ -118,6 +118,18 @@ Sites and the kinds they honor:
                          join/leave acceptance runs; optional
                          ``member`` selects the target, default the
                          last alive member)
+    engine.stage         once per loop-engine boundary execution
+                         (engine/core.py, BEFORE end_iteration runs —
+                         inline or on the staging worker)
+                         (``delay_stage``: sleep ``ms`` — wedges the
+                         side-band boundary; under pipelining the learn
+                         path continues and boundaries past the
+                         ``stage_timeout_s`` bound are SKIPPED, counted
+                         in ``engine/skipped_boundaries``, never silent;
+                         ``kill_stage``: raise FaultInjected in the
+                         boundary — counted in ``engine/stage_kills``,
+                         training continues, the firing surfaces through
+                         the drained ``fault`` event)
     gateway.session      once per gateway serve-loop pass
                          (``drop_frame``: swallow the act reply frame —
                          the client's bounded resend redelivers against
@@ -157,6 +169,7 @@ from typing import Any
 SITES = frozenset(
     {
         "trainer.iteration",
+        "engine.stage",
         "env_worker.step",
         "transport.send",
         "server.serve",

@@ -1,0 +1,596 @@
+"""The loop engine (ISSUE 19): one software-pipelined iteration skeleton
+for all five drivers.
+
+Two layers of coverage:
+
+- **Engine-level** (FakeHooks): the boundary pipeline's contracts in
+  isolation — wedged-stage bound (a stalled publish can never stall
+  learn past ``stage_timeout_s``; skipped boundaries are counted, never
+  silent), ``kill_stage`` chaos absorption, the inline interrupt latch,
+  donation-safe state pinning, skip-boundary accounting, and the
+  every-iteration stop agreement the multihost drivers hang off.
+- **Driver-level parity**: with ``engine.pipeline_sidebands`` OFF
+  (default) the engine is the historical loop — the whole existing test
+  suite regression-tests that. With it ON, the deterministic drivers
+  (device PPO, host-alternate PPO, device DDPG) must produce
+  BIT-IDENTICAL params and metrics (minus the engine's own gauges):
+  pipelining moves side-effect stages off the critical path, it does not
+  reorder the training math. The SEED/overlap drivers are covered by the
+  engine-level tests — their acting-timing nondeterminism predates the
+  engine and is absorbed by V-trace/replay, not by the boundary.
+"""
+
+import glob
+import hashlib
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from surreal_tpu.engine import (
+    EngineConfig,
+    LoopEngine,
+    LoopState,
+    Outcome,
+    StageSpec,
+    overlap_collect,
+    sideband_stages,
+)
+from surreal_tpu.session.config import Config
+from surreal_tpu.session.default_configs import base_config
+from surreal_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _reset_registry():
+    yield
+    faults.configure(None)  # never leak a plan into the next test
+
+
+# -- stage/config declarations ------------------------------------------------
+
+def test_stagespec_requires_explicit_donation():
+    with pytest.raises(TypeError):
+        StageSpec("collect")  # donate has no default, by design
+    spec = StageSpec("learn", donate=True, deferrable=False)
+    assert spec.describe() == {
+        "name": "learn", "donate": True, "deferrable": False,
+        "overlap": False,
+    }
+
+
+def test_sideband_stages_shape():
+    names = [s.name for s in sideband_stages()]
+    assert names == ["publish", "checkpoint", "recover", "observe"]
+    by_name = {s.name: s for s in sideband_stages()}
+    assert not by_name["recover"].deferrable  # rollback stays synchronous
+    assert by_name["publish"].deferrable and by_name["checkpoint"].deferrable
+    assert all(not s.donate for s in sideband_stages())
+
+
+def test_engine_config_resolution():
+    assert EngineConfig.from_session(Config()) == EngineConfig()
+    cfg = Config(engine=Config(pipeline_sidebands=True, stage_timeout_s=2.5))
+    ec = EngineConfig.from_session(cfg)
+    assert ec.pipeline_sidebands and ec.stage_timeout_s == 2.5
+    assert not ec.inline().pipeline_sidebands  # multihost/replay pin
+    assert ec.inline().stage_timeout_s == 2.5
+
+
+def test_overlap_collect_resolution():
+    # historical default rides topology.overlap_rollouts
+    assert overlap_collect(Config(topology=Config())) is True
+    assert overlap_collect(
+        Config(topology=Config(overlap_rollouts=False))
+    ) is False
+    # engine.overlap_collect wins when set
+    assert overlap_collect(Config(
+        topology=Config(overlap_rollouts=False),
+        engine=Config(overlap_collect=True),
+    )) is True
+
+
+# -- engine-level: FakeHooks harness ------------------------------------------
+
+class _FakeRecovery:
+    pending = False
+
+
+class _FakeLog:
+    def __init__(self):
+        self.warnings = []
+
+    def warning(self, msg, *args):
+        self.warnings.append(msg % args if args else msg)
+
+
+class _FakeTracer:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+class _FakeOps:
+    def __init__(self):
+        self.rows = []
+
+    def push_local(self, tier, **kw):
+        self.rows.append((tier, kw))
+
+
+class FakeHooks:
+    """The SessionHooks surface the engine touches, recorded."""
+
+    def __init__(self):
+        self.recovery = _FakeRecovery()
+        self.log = _FakeLog()
+        self.tracer = _FakeTracer()
+        self.ops = _FakeOps()
+        self.interrupted = False
+        self.boundaries = []  # (iteration, env_steps, state, metrics_row)
+
+    def end_iteration(self, iteration, env_steps, state, key,
+                      metrics=None, on_metrics=None):
+        row = metrics() if callable(metrics) else metrics
+        s = state() if callable(state) else state
+        self.boundaries.append((iteration, env_steps, s, row))
+        # the boundary-side stop verdict stays False here: these tests pin
+        # the engine's INLINE interrupt latch, which must work alone
+        return row, False
+
+
+def _stages(donate=False):
+    return (
+        StageSpec("collect", donate=donate),
+        StageSpec("learn", donate=donate),
+    ) + sideband_stages()
+
+
+def _counting_step(log=None):
+    def step(ls):
+        if log is not None:
+            log.append(ls.iteration)
+        ls.state = ls.iteration + 1
+        return Outcome(metrics={"loss": 0.5}, hook_key=None, steps=1)
+
+    return step
+
+
+def test_inline_mode_runs_every_boundary():
+    hooks = FakeHooks()
+    engine = LoopEngine(
+        hooks, 5, _counting_step(), _stages(), EngineConfig()
+    )
+    assert not engine.pipelined
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.iteration == 5 and ls.env_steps == 5
+    assert [b[0] for b in hooks.boundaries] == [1, 2, 3, 4, 5]
+    # every boundary saw the state of ITS iteration, not a later one
+    assert [b[2] for b in hooks.boundaries] == [1, 2, 3, 4, 5]
+
+
+def test_pipelined_mode_runs_every_boundary_and_flushes():
+    hooks = FakeHooks()
+    engine = LoopEngine(
+        hooks, 8, _counting_step(), _stages(),
+        EngineConfig(pipeline_sidebands=True),
+    )
+    assert engine.pipelined
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.iteration == 8
+    # the deferred final boundary drained at loop exit (_flush), so no
+    # boundary — and no checkpoint/publish riding it — was lost
+    assert sorted(b[0] for b in hooks.boundaries) == list(range(1, 9))
+    assert engine._pending is None
+    assert engine.gauge_row()["engine/deferred_boundaries"] == 8.0
+    assert engine.gauge_row()["engine/skipped_boundaries"] == 0.0
+
+
+def test_pipelined_requires_deferrable_stage_and_hooks():
+    cfg = EngineConfig(pipeline_sidebands=True)
+    only_compute = (
+        StageSpec("collect", donate=False), StageSpec("learn", donate=False),
+    )
+    assert not LoopEngine(
+        FakeHooks(), 1, _counting_step(), only_compute, cfg
+    ).pipelined
+    assert not LoopEngine(
+        None, 1, _counting_step(), _stages(), cfg
+    ).pipelined
+
+
+def test_wedged_boundary_cannot_stall_learn_past_bound():
+    """The satellite's headline guarantee: a publish/observe stage wedged
+    by ``delay_stage`` never blocks the compute loop for more than
+    ``stage_timeout_s`` per iteration — subsequent boundaries are skipped
+    AND COUNTED (never silent), and the wedged one still drains at loop
+    exit."""
+    faults.configure([
+        {"site": "engine.stage", "kind": "delay_stage", "at": 1, "ms": 600},
+    ])
+    hooks = FakeHooks()
+    engine = LoopEngine(
+        hooks, 10, _counting_step(), _stages(),
+        EngineConfig(pipeline_sidebands=True, stage_timeout_s=0.05),
+    )
+    t0 = time.perf_counter()
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    wall = time.perf_counter() - t0
+    assert ls.iteration == 10  # compute never stalled out the budget
+    assert engine._skipped >= 1
+    assert engine.gauge_row()["engine/skipped_boundaries"] >= 1.0
+    assert hooks.log.warnings  # the wedge was reported, not swallowed
+    assert engine._pending is None  # drained (or abandoned, counted) at exit
+    # bound sanity: 10 iterations x 50ms timeout + one 600ms drain + slack
+    assert wall < 5.0
+
+
+def test_kill_stage_chaos_is_counted_not_fatal():
+    faults.configure([
+        {"site": "engine.stage", "kind": "kill_stage", "at": 1},
+    ])
+    hooks = FakeHooks()
+    engine = LoopEngine(
+        hooks, 6, _counting_step(), _stages(),
+        EngineConfig(pipeline_sidebands=True),
+    )
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.iteration == 6  # training survived the killed side-band
+    assert engine.gauge_row()["engine/stage_kills"] == 1.0
+    # the killed boundary is the ONE missing from the record
+    assert len(hooks.boundaries) == 5
+
+
+def test_kill_stage_inline_is_also_absorbed():
+    faults.configure([
+        {"site": "engine.stage", "kind": "kill_stage", "at": 0},
+    ])
+    hooks = FakeHooks()
+    engine = LoopEngine(hooks, 3, _counting_step(), _stages(), EngineConfig())
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.iteration == 3
+    assert engine.gauge_row()["engine/stage_kills"] == 1.0
+
+
+def test_interrupt_latch_checked_inline_every_iteration():
+    """SIGTERM discipline under overlap: the latch is polled on the main
+    thread every iteration, so the loop stops at the NEXT iteration
+    boundary even while boundaries are deferred — and the deferred
+    boundary (the one the emergency checkpoint rides) still completes."""
+    hooks = FakeHooks()
+    log = []
+
+    def step(ls):
+        log.append(ls.iteration)
+        if ls.iteration == 3:  # latch mid-run, as a signal handler would
+            hooks.interrupted = True
+        return Outcome(metrics={}, hook_key=None, steps=1)
+
+    engine = LoopEngine(
+        hooks, 100, step, _stages(),
+        EngineConfig(pipeline_sidebands=True),
+    )
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.iteration == 4  # stopped at the boundary, not env-steps end
+    # iteration 4's deferred boundary drained before the engine returned,
+    # so the driver's final_checkpoint sees a fully-published history
+    assert sorted(b[0] for b in hooks.boundaries) == [1, 2, 3, 4]
+    assert engine._pending is None
+
+
+def test_agree_stop_consulted_every_iteration():
+    """The multihost seam: ``agree_stop`` (rank 0's broadcast decision)
+    can stop the loop even when this rank's own boundary said keep-going
+    — and it is consulted even with hooks=None (ranks > 0)."""
+    votes = []
+
+    def agree(iteration, stop):
+        votes.append((iteration, stop))
+        return iteration >= 3
+
+    engine = LoopEngine(
+        None, 100, _counting_step(), _stages(), EngineConfig(),
+        agree_stop=agree,
+    )
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.iteration == 3
+    assert votes == [(1, False), (2, False), (3, False)]
+
+
+def test_skip_boundary_counts_steps_without_iteration():
+    """The SEED stale-drop contract: skipped chunks consume env-step
+    budget but run no boundary and count no iteration."""
+    hooks = FakeHooks()
+
+    def step(ls):
+        skip = (ls.env_steps % 2) == 0  # every other chunk is stale
+        return Outcome(
+            metrics={}, hook_key=None, steps=1, skip_boundary=skip,
+        )
+
+    engine = LoopEngine(hooks, 6, step, _stages(), EngineConfig())
+    ls = engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    assert ls.env_steps == 6
+    assert ls.iteration == 3  # only non-skipped chunks counted
+    assert [b[0] for b in hooks.boundaries] == [1, 2, 3]
+
+
+def test_donation_pins_a_device_snapshot():
+    """Donation-safe handoff: when a declared stage donates and the
+    boundary is deferred, the state the boundary reads is a device
+    snapshot taken BEFORE the next donating dispatch can reuse the
+    buffers — a different array, equal contents. Non-donating stage sets
+    pass the reference through (rebinding discipline is the pin)."""
+    state = jnp.arange(4.0)
+    ls = LoopState(state=state, key=None, iteration=0, env_steps=0)
+    out = Outcome(metrics={}, hook_key=None, steps=1)
+
+    donating = LoopEngine(
+        FakeHooks(), 1, _counting_step(), _stages(donate=True),
+        EngineConfig(pipeline_sidebands=True),
+    )
+    pinned = donating._pin_state(ls, out)
+    assert pinned is not state
+    np.testing.assert_array_equal(np.asarray(pinned), np.asarray(state))
+
+    by_ref = LoopEngine(
+        FakeHooks(), 1, _counting_step(), _stages(donate=False),
+        EngineConfig(pipeline_sidebands=True),
+    )
+    assert by_ref._pin_state(ls, out) is state
+    # inline mode never copies, donating or not
+    inline = LoopEngine(
+        FakeHooks(), 1, _counting_step(), _stages(donate=True),
+        EngineConfig(),
+    )
+    assert inline._pin_state(ls, out) is state
+
+
+def test_engine_observability_surfaces():
+    """The engine's gauges are registered, its event renders in diag's
+    'Loop engine' section, and the ops push feeds `surreal_tpu top`."""
+    from surreal_tpu.session.costs import GAUGE_REGISTRY
+    from surreal_tpu.session.opsplane import top_report
+    from surreal_tpu.session.telemetry import _engine_lines
+
+    hooks = FakeHooks()
+    engine = LoopEngine(
+        hooks, 4, _counting_step(), _stages(),
+        EngineConfig(pipeline_sidebands=True),
+    )
+    engine.run(LoopState(state=0, key=None, iteration=0, env_steps=0))
+    row = engine.gauge_row()
+    for name in row:
+        assert name in GAUGE_REGISTRY, f"undocumented gauge {name}"
+    # every metrics row carried the engine gauges
+    assert all(
+        "engine/occupancy" in (b[3] or {}) for b in hooks.boundaries
+    )
+    # the telemetry event fired at the cadence and renders in diag
+    kinds = [k for k, _ in hooks.tracer.events]
+    assert "engine" in kinds
+    lines = _engine_lines({"engine": engine._event_fields()})
+    assert any("pipelined=True" in ln for ln in lines)
+    assert any("collect" in ln for ln in lines)
+    # the ops tier body feeds the same renderer in `surreal_tpu top`
+    assert hooks.ops.rows and hooks.ops.rows[0][0] == "engine"
+    snap = {
+        "t": time.time(),
+        "tiers": {
+            "engine": {
+                "age_s": 0.1, "cadence_s": 5.0,
+                "body": engine._event_fields(),
+            },
+        },
+    }
+    assert "Loop engine" in top_report(snap)
+
+
+# -- driver-level: pipelining-off bit parity ----------------------------------
+
+def _digest(tree) -> str:
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            leaf = jax.random.key_data(leaf)
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _scrub(row: dict) -> dict:
+    """Drop wall-clock and engine-bookkeeping keys: those are ALLOWED to
+    differ between inline and pipelined runs; the training math is not."""
+    return {
+        k: v for k, v in row.items()
+        if not k.startswith(("time/", "engine/", "perf/"))
+    }
+
+
+def _run_driver(make_trainer, cfg):
+    rows = []
+    state, metrics = make_trainer(cfg).run(
+        on_metrics=lambda it, m: rows.append((it, _scrub(m)))
+    )
+    return _digest(state), rows, metrics
+
+
+def _restore_ckpt_digest(folder, trainer):
+    """Digest of the newest checkpoint's params (exactness: pipelined
+    checkpoints must be byte-identical to inline ones)."""
+    from surreal_tpu.session.checkpoint import CheckpointManager
+
+    cm = CheckpointManager(str(folder))
+    restored = cm.restore(trainer.learner.init(jax.random.key(99)))
+    cm.close()
+    assert restored is not None
+    return _digest(restored[0]), restored[1]
+
+
+def _ppo_device_cfg(folder, pipeline):
+    return Config(
+        learner_config=Config(algo=Config(name="ppo", horizon=16)),
+        env_config=Config(name="jax:cartpole", num_envs=8),
+        session_config=Config(
+            folder=str(folder),
+            seed=7,
+            total_env_steps=8 * 16 * 5,  # 5 iterations
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=2),
+            eval=Config(every_n_iters=0),
+            engine=Config(pipeline_sidebands=pipeline),
+        ),
+    ).extend(base_config())
+
+
+def test_ppo_device_pipelined_parity(tmp_path):
+    from surreal_tpu.launch.trainer import Trainer
+
+    off_cfg = _ppo_device_cfg(tmp_path / "off", False)
+    on_cfg = _ppo_device_cfg(tmp_path / "on", True)
+    d_off, rows_off, _ = _run_driver(Trainer, off_cfg)
+    d_on, rows_on, _ = _run_driver(Trainer, on_cfg)
+    assert d_off == d_on, "pipelining changed the training math"
+    assert len(rows_off) == len(rows_on) == 5
+    for (it_a, ma), (it_b, mb) in zip(rows_off, rows_on):
+        assert it_a == it_b and ma.keys() == mb.keys()
+        for k in ma:
+            va, vb = ma[k], mb[k]
+            if isinstance(va, float) and np.isnan(va) and np.isnan(vb):
+                continue
+            assert va == vb, f"iter {it_a} metric {k}: {va} != {vb}"
+    # checkpoint exactness: the deferred checkpoint stage wrote the same
+    # bytes at the same step as the inline one
+    co, mo = _restore_ckpt_digest(tmp_path / "off", Trainer(off_cfg))
+    cn, mn = _restore_ckpt_digest(tmp_path / "on", Trainer(on_cfg))
+    assert mo == mn
+    assert co == cn
+    # the pipelined session's telemetry carries the engine event + diag
+    from surreal_tpu.session.telemetry import diag_report
+
+    report = diag_report(str(tmp_path / "on"))
+    assert report is not None and "Loop engine" in report
+    assert "pipelined=True" in report
+
+
+def test_ppo_host_alternate_pipelined_parity(tmp_path):
+    """Host alternate loop (overlap_rollouts=false): strict-mode record
+    must be bit-identical with pipelining on."""
+    from surreal_tpu.launch.trainer import Trainer
+
+    def cfg(folder, pipeline):
+        return Config(
+            learner_config=Config(algo=Config(name="ppo", horizon=16, epochs=2)),
+            env_config=Config(name="gym:CartPole-v1", num_envs=4),
+            session_config=Config(
+                folder=str(folder),
+                seed=11,
+                total_env_steps=16 * 4 * 4,  # 4 iterations
+                metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+                topology=Config(overlap_rollouts=False),
+                engine=Config(pipeline_sidebands=pipeline),
+            ),
+        ).extend(base_config())
+
+    d_off, rows_off, _ = _run_driver(Trainer, cfg(tmp_path / "off", False))
+    d_on, rows_on, _ = _run_driver(Trainer, cfg(tmp_path / "on", True))
+    assert d_off == d_on
+    assert len(rows_off) == len(rows_on) == 4
+    for (_, ma), (_, mb) in zip(rows_off, rows_on):
+        for k in ma:
+            va, vb = ma[k], mb[k]
+            if isinstance(va, float) and np.isnan(va) and np.isnan(vb):
+                continue
+            assert va == vb, f"{k}: {va} != {vb}"
+
+
+def test_ddpg_device_pipelined_parity(tmp_path):
+    """Fused off-policy device driver: donation-safe handoff under test —
+    the fused program donates state+replay+carry, so the deferred
+    boundary reads the pinned snapshot, and the record must stay
+    bit-identical."""
+    from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
+
+    def cfg(folder, pipeline):
+        return Config(
+            learner_config=Config(
+                algo=Config(
+                    name="ddpg", horizon=8, updates_per_iter=2,
+                    exploration=Config(warmup_steps=0),
+                ),
+                replay=Config(
+                    kind="uniform", capacity=1024,
+                    start_sample_size=64, batch_size=32,
+                ),
+            ),
+            env_config=Config(name="jax:pendulum", num_envs=8),
+            session_config=Config(
+                folder=str(folder),
+                seed=3,
+                total_env_steps=8 * 8 * 5,  # 5 iterations
+                metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+                checkpoint=Config(every_n_iters=0),
+                eval=Config(every_n_iters=0),
+                engine=Config(pipeline_sidebands=pipeline),
+            ),
+        ).extend(base_config())
+
+    d_off, rows_off, _ = _run_driver(OffPolicyTrainer, cfg(tmp_path / "off", False))
+    d_on, rows_on, _ = _run_driver(OffPolicyTrainer, cfg(tmp_path / "on", True))
+    assert d_off == d_on
+    assert len(rows_off) == len(rows_on) == 5
+    for (_, ma), (_, mb) in zip(rows_off, rows_on):
+        for k in ma:
+            va, vb = ma[k], mb[k]
+            if isinstance(va, float) and np.isnan(va) and np.isnan(vb):
+                continue
+            assert va == vb, f"{k}: {va} != {vb}"
+
+
+def test_sigterm_under_overlap_emergency_checkpoint(tmp_path):
+    """The preemption contract survives pipelining: SIGTERM (chaos
+    ``sigterm`` injection) latches mid-run, the engine stops at the next
+    iteration boundary, the deferred boundary drains, and the emergency
+    checkpoint lands at the interrupted iteration — same as the inline
+    path (tests/test_recovery.py pins that one)."""
+    from surreal_tpu.launch.trainer import Trainer
+
+    steps_per_iter = 16 * 8
+    cfg = Config(
+        learner_config=Config(
+            algo=Config(name="ppo", horizon=16, epochs=2, num_minibatches=2)
+        ),
+        env_config=Config(name="jax:pendulum", num_envs=8),
+        session_config=Config(
+            folder=str(tmp_path),
+            total_env_steps=20 * steps_per_iter,
+            metrics=Config(every_n_iters=1, tensorboard=False, console=False),
+            checkpoint=Config(every_n_iters=1000),
+            eval=Config(every_n_iters=0),
+            faults=Config(
+                plan=[{"site": "trainer.iteration", "kind": "sigterm", "at": 3}]
+            ),
+            engine=Config(pipeline_sidebands=True),
+        ),
+    ).extend(base_config())
+    Trainer(cfg).run()
+    ckpts = sorted(
+        int(os.path.basename(p))
+        for p in glob.glob(os.path.join(str(tmp_path), "checkpoints", "*"))
+        if os.path.basename(p).isdigit()
+    )
+    assert ckpts == [4]  # emergency save at the interrupted boundary
+    events = []
+    with open(os.path.join(str(tmp_path), "telemetry", "events.jsonl")) as f:
+        for line in f:
+            if line.strip():
+                events.append(json.loads(line))
+    kinds = [e.get("kind") for e in events if e.get("type") == "recovery"]
+    assert "interrupt" in kinds

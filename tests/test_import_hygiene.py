@@ -394,7 +394,7 @@ def test_perf_gauges_appear_in_registry():
 
     lit = re.compile(
         r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo"
-        r"|lineage|trace|remediation|loadgen|lgroup|tier)"
+        r"|lineage|trace|remediation|loadgen|lgroup|tier|engine)"
         r"/[a-z0-9_]+)[\"']"
     )
     bad = []
@@ -410,7 +410,7 @@ def test_perf_gauges_appear_in_registry():
                 )
     assert not bad, (
         "perf/replay/experience/fleet/param/gateway/ops/slo/lineage/trace/"
-        "remediation/loadgen/lgroup gauges emitted "
+        "remediation/loadgen/lgroup/tier/engine gauges emitted "
         "but not documented in session/costs.py::GAUGE_REGISTRY:\n"
         + "\n".join(bad)
     )
@@ -419,7 +419,7 @@ def test_perf_gauges_appear_in_registry():
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
              "gateway/", "ops/", "slo/", "lineage/", "trace/",
-             "remediation/", "loadgen/", "lgroup/", "tier/")
+             "remediation/", "loadgen/", "lgroup/", "tier/", "engine/")
         ), name
 
 
@@ -511,6 +511,65 @@ def test_gateway_reuses_shared_supervision_utilities():
     )
     assert "alloc_address" in server_src, (
         "gateway/server.py no longer uses utils/net.py::alloc_address"
+    )
+
+
+def test_training_loop_skeleton_lives_in_engine_only():
+    """Loop-engine lint (ISSUE 19 tentpole): the hand-threaded training
+    loop skeleton — ``while env_steps < total`` / ``while ls.env_steps``
+    and friends — may exist ONLY in ``engine/core.py``. Every driver
+    (trainer.py, offpolicy_trainer.py, seed_trainer.py, the multihost
+    subclasses) declares stages and hands the engine a step closure; a
+    new driver hand-rolling its own iteration loop silently forks the
+    boundary contract (publish/checkpoint/recover/observe ordering,
+    interrupt latch, chaos firing) this PR unified. Warmup/eval/bench
+    helper loops that do not advance ``env_steps`` stay legal — the scan
+    keys on the env-step budget condition, the loop head only the
+    skeleton may own."""
+    import re
+
+    loop_head = re.compile(r"while\s+[\w.\[\]\"']*env_steps\b")
+    bad = []
+    for path in sorted(_PKG_ROOT.rglob("*.py")):
+        rel = path.relative_to(_PKG_ROOT)
+        if str(rel) == "engine/core.py":
+            continue
+        src = path.read_text()
+        for m in loop_head.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            bad.append(f"{path.relative_to(_REPO_ROOT)}:{line}")
+    assert not bad, (
+        "hand-threaded training loop heads outside engine/core.py (port "
+        "the driver to surreal_tpu.engine.LoopEngine — declare stages, "
+        "hand it a step closure):\n" + "\n".join(bad)
+    )
+    # and the engine actually owns one — the lint dies loudly if the
+    # skeleton moves rather than silently scanning nothing
+    assert loop_head.search((_PKG_ROOT / "engine" / "core.py").read_text()), (
+        "engine/core.py no longer contains the loop skeleton; update this lint"
+    )
+
+
+def test_stage_specs_declare_donation():
+    """Stage-donation lint (ISSUE 19 satellite, the jit-donation lint
+    lifted to the stage layer): every ``StageSpec(...)`` construction in
+    the package must spell ``donate=`` explicitly. The engine's
+    donation-safe handoff (snapshot the param tree before a deferred
+    boundary reads storage a donating dispatch will reuse) keys off this
+    bit — a stage that omits it either misses the snapshot (use-after-
+    free under pipelining) or pays a copy it didn't need. The dataclass
+    has no default on purpose; this lint keeps call sites honest even
+    for positional spellings."""
+    bad = []
+    for path in sorted(_PKG_ROOT.rglob("*.py")):
+        src = path.read_text()
+        for line, call in _call_spans(src, "StageSpec"):
+            if "donate=" not in call:
+                bad.append(f"{path.relative_to(_REPO_ROOT)}:{line}")
+    assert not bad, (
+        "StageSpec constructions without an explicit donate= decision "
+        "(state whether the stage's jitted program donates its "
+        "loop-carried inputs):\n" + "\n".join(bad)
     )
 
 

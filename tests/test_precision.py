@@ -262,7 +262,21 @@ def _tree_close(a, b, atol):
         )
 
 
-@pytest.mark.parametrize("algo", ["ppo", "impala"])
+@pytest.mark.parametrize(
+    "algo",
+    [
+        # tier-1 keeps the ppo arm: both arms exercise the SAME precision
+        # machinery (staging casts, loss scaling, the 'mixed'-vs-'bf16'
+        # rounding-point identity) through the same fused-iteration
+        # harness, and impala's distinct arithmetic (the v-trace
+        # recurrence) keeps its own tier-1 equivalence coverage in
+        # tests/test_tune.py — the impala arm rides the slow tier
+        # (ISSUE 19 suite-wall headroom pass, same precedent as the
+        # tuned-program sweeps)
+        "ppo",
+        pytest.param("impala", marks=pytest.mark.slow),
+    ],
+)
 def test_bf16_vs_f32_fused_iteration(algo):
     # impala pins vtrace_impl so the cache key collides with the
     # vtrace-equivalence test's xla arm (one compile, not two)
@@ -280,7 +294,13 @@ def test_bf16_vs_f32_fused_iteration(algo):
     _tree_close(s16.params, sm.params, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bf16_vs_f32_ddpg_updates():
+    # slow tier (ISSUE 19 headroom pass): the staging-cast/loss-scale
+    # machinery this compares is the same ops/precision.py path the ppo
+    # fused arm pins in tier-1; the off-policy-specific piece (actor/
+    # critic trees through the fused replay iteration) adds two full
+    # compiles for ~20 s of tier-1 wall
     from surreal_tpu.launch.offpolicy_trainer import OffPolicyTrainer
 
     def run(policy):
