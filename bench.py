@@ -342,6 +342,14 @@ def main() -> int:
         from perf_wallclock import learner_group_main
 
         return learner_group_main(sys.argv[1:])
+    if "--chaos" in sys.argv:
+        # chaos campaign (ISSUE 20): N seeded short real runs under
+        # generated multi-site fault schedules, judged by the invariant
+        # oracles, failures shrunk to minimal repros — writes
+        # CHAOS_campaign.json (perf_gate's chaos gate consumes it)
+        from perf_wallclock import chaos_main
+
+        return chaos_main(sys.argv[1:])
     if "--loop-engine" in sys.argv:
         # loop-engine campaign (ISSUE 19): per-driver iteration time with
         # boundary pipelining off (the legacy inline loop) vs on, plus the

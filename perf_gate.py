@@ -828,6 +828,67 @@ def gate_tier1(art_dir: str, out=sys.stdout) -> int:
     return rc
 
 
+def gate_chaos(art_dir: str, out=sys.stdout) -> int:
+    """Chaos-campaign gate (ISSUE 20): the committed
+    ``CHAOS_campaign.json`` (``surreal_tpu chaos all --seeds N --out``)
+    must record a campaign broad enough to mean something and clean
+    enough to ship:
+
+    - >= 25 seeded schedules actually ran (``chaos/schedules``);
+    - >= 10 DISTINCT fault sites fired (``sites_covered`` counts sites
+      whose faults were delivered, not merely drawn — a schedule whose
+      faults never reach their call counts proves nothing);
+    - ZERO invariant violations and zero recorded failures — a failing
+      schedule ships as a shrunk minimal repro in ``failures``, and a
+      repo with a known-failing chaos seed must gate red until the bug
+      (or the oracle) is fixed.
+
+    rc 0 with a note when the artifact is absent (a missing campaign is
+    a campaign problem, not a regression)."""
+    path = os.path.join(art_dir, "CHAOS_campaign.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("perf_gate: no CHAOS_campaign.json — chaos campaign not "
+              "run (rc 0)", file=out)
+        return 0
+    if not isinstance(data, dict) or data.get("kind") != "chaos_campaign":
+        print("perf_gate: CHAOS_campaign.json is not a campaign artifact "
+              "(rc 0)", file=out)
+        return 0
+    rc = 0
+    g = data.get("gauges") or {}
+    n_sched = int(g.get("chaos/schedules", 0))
+    n_sites = int(g.get("chaos/sites_covered",
+                        len(data.get("sites_covered") or ())))
+    n_viol = int(g.get("chaos/violations", 0))
+    n_fail = len(data.get("failures") or ())
+    line = (
+        f"perf_gate: chaos campaign {n_sched} schedules, {n_sites} "
+        f"distinct fired sites, {n_viol} violations "
+        f"(commitments >= 25 schedules, >= 10 sites, 0 violations)"
+    )
+    if n_sched < 25:
+        print(line + " — CAMPAIGN TOO SMALL", file=out)
+        rc = 1
+    elif n_sites < 10:
+        print(line + " — SITE COVERAGE TOO NARROW", file=out)
+        rc = 1
+    elif n_viol > 0 or n_fail > 0:
+        print(line + " — INVARIANT VIOLATIONS ON RECORD", file=out)
+        for fail in (data.get("failures") or ())[:5]:
+            print(
+                f"perf_gate:   chaos repro profile={fail.get('profile')} "
+                f"seed={fail.get('seed')} minimal_plan="
+                f"{len(fail.get('minimal_plan') or ())} spec(s)", file=out,
+            )
+        rc = 1
+    else:
+        print(line + " — ok", file=out)
+    return rc
+
+
 def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
     # the experience-plane, act-path, gateway, ops-plane, trace,
     # watchdog, control, and tier-1 budget gates are independent of the
@@ -839,7 +900,7 @@ def gate(art_dir: str, threshold: float, out=sys.stdout) -> int:
         gate_trace(art_dir, out=out), gate_watchdog(art_dir, out=out),
         gate_control(art_dir, out=out), gate_learner_group(art_dir, out=out),
         gate_replay_tiers(art_dir, out=out), gate_engine(art_dir, out=out),
-        gate_tier1(art_dir, out=out),
+        gate_tier1(art_dir, out=out), gate_chaos(art_dir, out=out),
     )
     rows = load_rows(art_dir)
     valid = [r for r in rows if not r.get("failed")]

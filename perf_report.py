@@ -1854,6 +1854,62 @@ def _engine_lines() -> list[str]:
     return lines
 
 
+def _chaos_lines() -> list[str]:
+    """The 'Chaos campaigns' PERF.md section: static mechanism text plus
+    the campaign summary from the committed CHAOS_campaign.json. One
+    function so ``main()`` and the committed PERF.md cannot drift."""
+    lines = [
+        "",
+        "## Chaos campaigns (randomized multi-site fault schedules)",
+        "",
+        "`surreal_tpu chaos <algo|all> [env] --seeds N` runs N seeded "
+        "short REAL training runs, each under a deterministic multi-site "
+        "fault schedule drawn by `chaos/schedule.py` over the "
+        "`utils/faults.py` site registry (per-site kind vocabulary, "
+        "kill/nan caps, exclusive co-fire groups, a per-schedule "
+        "injected-delay budget). Every run is judged post-hoc by the "
+        "`chaos/invariants.py` oracles — exactly-once row conservation "
+        "at the quiesced close boundary, counted-never-silent (every "
+        "delivered fault leaves a declared counter delta), monotone "
+        "published/served param versions and cumulative counters, zero "
+        "thread/shm/fd residue after teardown, newest-checkpoint finite "
+        "restorability, spill-WAL re-read consistency, and fault "
+        "surfacing (every delivered fault appears as a `fault` telemetry "
+        "event). A failing schedule is greedily shrunk (drop one spec, "
+        "re-run deterministically) to a 1-minimal repro and recorded "
+        "with its `(profile, seed)` replay key. "
+        "`perf_gate.gate_chaos` holds the committed campaign to >= 25 "
+        "schedules over >= 10 distinct FIRED sites with zero violations.",
+    ]
+    try:
+        with open("CHAOS_campaign.json") as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return lines
+    if not isinstance(data, dict) or data.get("kind") != "chaos_campaign":
+        return lines
+    g = data.get("gauges") or {}
+    by_prof: dict[str, int] = {}
+    for s in data.get("schedules") or ():
+        by_prof[s.get("profile", "?")] = by_prof.get(
+            s.get("profile", "?"), 0) + 1
+    lines += [
+        "",
+        f"Committed campaign (`CHAOS_campaign.json`): "
+        f"{int(g.get('chaos/schedules', 0))} schedules over profiles "
+        + ", ".join(f"`{p}` ({n})" for p, n in sorted(by_prof.items()))
+        + f"; {int(g.get('chaos/faults_injected', 0))} faults delivered "
+        f"across {int(g.get('chaos/sites_covered', 0))} distinct sites; "
+        f"{int(g.get('chaos/violations', 0))} invariant violations; "
+        f"wall {float(g.get('chaos/run_ms', 0)) / 1e3:,.0f} s.",
+        "",
+        "Fired sites: "
+        + ", ".join(f"`{s}`" for s in data.get("sites_covered") or ())
+        + ".",
+    ]
+    return lines
+
+
 def _autotuner_lines() -> list[str]:
     """The 'Program autotuner' PERF.md section: static mechanism text plus
     the measured table from the BENCH_tune.json artifact when one exists.
@@ -2489,6 +2545,7 @@ def main(argv=None) -> None:
     lines += _control_lines()
     lines += _replay_tiers_lines()
     lines += _engine_lines()
+    lines += _chaos_lines()
     if scaling:
         lines += [
             "",

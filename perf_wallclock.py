@@ -2356,6 +2356,42 @@ def engine_main(argv) -> int:
     return 0
 
 
+def chaos_main(argv) -> int:
+    """--chaos driver (ISSUE 20): the randomized chaos-campaign artifact.
+    Thin delegate over ``surreal_tpu chaos`` — N seeded short real runs
+    under generated multi-site fault schedules, every run judged by the
+    invariant oracles, failures shrunk to minimal repros. Writes
+    ``CHAOS_campaign.json`` for ``perf_gate.gate_chaos`` and PERF.md's
+    chaos section. rc 1 when any schedule recorded a violation (the
+    committed artifact must be a clean campaign)."""
+    import sys
+    import tempfile
+
+    from surreal_tpu.chaos.campaign import run_campaign, write_artifact
+
+    out_path = "CHAOS_campaign.json"
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    seeds = 25
+    if "--seeds" in argv:
+        seeds = int(argv[argv.index("--seeds") + 1])
+    base_dir = (
+        argv[argv.index("--dir") + 1] if "--dir" in argv
+        else tempfile.mkdtemp(prefix="surreal_chaos_")
+    )
+    artifact = run_campaign(seeds, base_dir)
+    write_artifact(out_path, artifact)
+    print(json.dumps(artifact["gauges"]))
+    if artifact["failures"]:
+        print(
+            f"chaos: {len(artifact['failures'])} failing schedule(s) — see "
+            f"{out_path} failures[] for the shrunk minimal repros",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main(argv=None) -> None:
     import os
     import sys
@@ -2379,6 +2415,8 @@ def main(argv=None) -> None:
         sys.exit(control_main(argv))
     if "--learner-group" in argv:
         sys.exit(learner_group_main(argv))
+    if "--chaos" in argv:
+        sys.exit(chaos_main(argv))
     n = 3
     if "--seeds" in argv:
         n = int(argv[argv.index("--seeds") + 1])

@@ -324,6 +324,9 @@ class ExperiencePlane:
                 if self.sampler is not None else 0.0
             ),
             "experience/dropped_rows": float(self.sender.dropped_rows),
+            "experience/sent_rows": float(
+                sum(l.sent_rows for l in self.sender.links)
+            ),
         }
         # tier/* family (registered in session/costs.py): only emitted
         # when a tier is live, so tiers-off metrics rows are unchanged
@@ -397,6 +400,37 @@ class ExperiencePlane:
                     "experience/sample_wait_ms",
                 )
             },
+        }
+
+    def accounting(self) -> dict[str, float]:
+        """Final exactly-once row accounting, read at a quiesced boundary
+        (collection stopped, shards still alive — call BEFORE ``_stop`` is
+        set). Read order matters: the sender side FIRST, the shard stats
+        poll second, so every row counted in ``sent_rows`` is — by the
+        time ``ingested_rows`` is read — either ingested, counted dropped,
+        or still inflight. Drivers emit this as the ``experience_close``
+        telemetry event; ``chaos/invariants.py`` asserts the conservation
+        law over it (strict only when ``rehellos``/``respawns`` are zero —
+        a watermark re-base or a restarted-empty shard legitimately
+        re-keys the ledgers)."""
+        snd = self.sender.gauges()
+        self._poll_stats()
+        stats = self._stats_cache
+        return {
+            "sent_rows": float(snd["sent_rows"]),
+            "dropped_rows": float(snd["dropped_rows"]),
+            "inflight_rows": float(snd["inflight_rows"]),
+            "resends": float(snd["resends"]),
+            "rehellos": float(snd["rehellos"]),
+            "dead_links": float(snd["dead_links"]),
+            "ingested_rows": sum(
+                float(s.get("ingested_rows", 0)) for s in stats
+            ),
+            "respawns": float(self.respawns),
+            "num_shards": float(self.num_shards),
+            "shards_live": float(
+                sum(1 for w in self.shards if w.is_alive())
+            ),
         }
 
     def close(self) -> None:

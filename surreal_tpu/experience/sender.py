@@ -111,6 +111,7 @@ class ExperienceSender:
         )
         self.dropped_rows = 0
         self.resends = 0
+        self.rehellos = 0
         self.wire_bytes = 0
         self._rr = 0  # FIFO-arm round-robin cursor
         if self.spec is not None:
@@ -135,6 +136,13 @@ class ExperienceSender:
         )
         if obj is None:
             return self._mark_dead(link)
+        # a re-hello (any negotiation past a link's first) re-bases
+        # sent_rows below, which breaks the global
+        # sent == ingested + dropped + inflight conservation the chaos
+        # exactly-once oracle checks — count them so the oracle knows
+        # when strict accounting no longer applies
+        if link.negotiated:
+            self.rehellos += 1
         # a respawned shard restarts empty: re-base the watermark counter
         # on what it actually holds, so samplers' deferral stays consistent
         link.sent_rows = int(obj.get("ingested_rows", 0))
@@ -353,11 +361,25 @@ class ExperienceSender:
     def watermarks(self) -> list[int]:
         return [link.sent_rows for link in self.links]
 
+    def inflight_rows(self) -> int:
+        """Rows sent but not yet acked (nor invalidated into
+        ``dropped_rows``) — the slack term in the chaos exactly-once
+        conservation oracle: at a quiesced boundary,
+        ``sent == ingested + dropped + inflight`` when no re-hello ever
+        re-based a watermark (``rehellos == 0``)."""
+        return int(sum(
+            entry[2]
+            for link in self.links
+            for entry in list(link.inflight.values())
+        ))
+
     def gauges(self) -> dict[str, float]:
         return {
             "sent_rows": float(sum(l.sent_rows for l in self.links)),
             "dropped_rows": float(self.dropped_rows),
             "resends": float(self.resends),
+            "rehellos": float(self.rehellos),
+            "inflight_rows": float(self.inflight_rows()),
             "wire_bytes_out": float(self.wire_bytes),
             "dead_links": float(sum(1 for l in self.links if l.dead)),
         }

@@ -828,6 +828,14 @@ class SessionHooks:
         self.writer.close()
         self._emit_cache_event()  # final counts for runs shorter than a cadence
         self.tracer.close()
+        # detach + close this session's file log handler: without this the
+        # fd into <folder>/logs/ outlives the session for the rest of the
+        # process (get_logger only retargets when a DIFFERENT folder
+        # arrives) — the chaos residue oracle counts that as a leak
+        for h in list(self.log.handlers):
+            if str(getattr(h, "_surreal_id", "")).startswith("file:"):
+                self.log.removeHandler(h)
+                h.close()
 
 
 HOST_METRICS_WINDOW = 20  # rolling episode-return window; host loops size

@@ -1269,6 +1269,17 @@ class OffPolicyTrainer:
             hooks.final_checkpoint(iteration, env_steps, state)
             return state, hooks.last_metrics
         finally:
+            # the collect stage (the only sender) ran on this thread, so
+            # the ledger is quiesced here — record the close accounting
+            # for the chaos exactly-once oracle before stopping the plane
+            try:
+                hooks.tracer.event(
+                    "experience_close", quiesced=1.0, **plane.accounting()
+                )
+            except Exception:
+                hooks.log.warning(
+                    "experience_close accounting failed", exc_info=True
+                )
             # unblock any bounded sender/sampler wait running on the
             # staging thread FIRST, so the prefetch join below succeeds
             # before plane.close() closes the sockets that thread is using

@@ -98,7 +98,17 @@ class _DataPlane:
         self.stop = stop
         self.respawns = 0
         self._timeout = first_timeout
-        self.steady_timeout = 30.0
+        # the steady starvation deadline must COVER the worker-silence
+        # recovery window: a worker wedged waiting on a reply that will
+        # never come (e.g. its step frame dropped on the wire) only
+        # self-kills after worker_silence_s, and the respawn that refills
+        # the chunk queue happens on our own supervise() pass after that —
+        # a deadline shorter than the budget makes the sole-worker
+        # recovery path unreachable (found by the chaos campaign:
+        # transport.send drop_frame wedged seed_experience forever)
+        self.steady_timeout = max(
+            30.0, float(getattr(trainer, "worker_silence_s", 0.0)) * 1.5
+        )
         self.last_chunk_age_s = 0.0  # queue dwell of the last chunk served
         # rolling queue-dwell samples for the per-hop latency percentiles
         # (the 'hops' telemetry event; appended by whichever thread runs
@@ -671,6 +681,27 @@ class SEEDTrainer:
                 )
                 self._gateway = gateway  # exposed for tests
                 hooks.log.info("session gateway live at %s", gateway.address)
+                # discovery file: how an external tenant finds — and
+                # RE-finds, after a cold restart rebinds the port — the
+                # live gateway (the param_server.json idiom: atomic
+                # tmp+rename, pollers race this write). Unlinked at
+                # close so a stale file never points tenants at a dead
+                # endpoint; surviving a SIGKILL is fine, the relaunch
+                # overwrites it before tenants can re-attach.
+                import json as _json
+                import os as _os
+
+                gw_discovery = _os.path.join(
+                    self.config.session_config.folder, "gateway.json"
+                )
+                tmp = gw_discovery + ".tmp"
+                with open(tmp, "w") as f:
+                    _json.dump(
+                        {"address": gateway.address,
+                         "lease_s": float(gw_cfg.get("lease_s", 30.0))},
+                        f,
+                    )
+                _os.replace(tmp, gw_discovery)
 
             # experience-plane chunk relay (FIFO arm): a relay thread
             # ships every assembled chunk through the ExperienceSender;
@@ -1047,9 +1078,26 @@ class SEEDTrainer:
             if prefetch is not None:
                 prefetch.close()
             if xplane is not None:
-                # unblock the relay's bounded sender waits and JOIN it
-                # before close() touches the DEALER sockets it shares
-                # (zmq sockets are not thread-safe)
+                # quiesce the relay first (the driver stop is already
+                # set) so the close accounting reads a settled ledger; a
+                # relay wedged in a bounded sender wait is unblocked by
+                # the plane stop below and the accounting marked
+                # unquiesced (the chaos exactly-once oracle then skips
+                # strict conservation for this run)
+                relay_thread.join(timeout=5)
+                try:
+                    hooks.tracer.event(
+                        "experience_close",
+                        quiesced=float(not relay_thread.is_alive()),
+                        **xplane.accounting(),
+                    )
+                except Exception:
+                    hooks.log.warning(
+                        "experience_close accounting failed", exc_info=True
+                    )
+                # unblock any remaining bounded sender waits and JOIN
+                # before close() touches the DEALER sockets the relay
+                # shares (zmq sockets are not thread-safe)
                 xplane._stop.set()
                 relay_thread.join(timeout=5)
                 xplane.close()
@@ -1057,6 +1105,14 @@ class SEEDTrainer:
                 # sessions die with the run; close BEFORE the fleet so the
                 # gateway never serves into torn-down replicas
                 gateway.close()
+                import os as _os
+
+                try:
+                    _os.unlink(_os.path.join(
+                        self.config.session_config.folder, "gateway.json"
+                    ))
+                except OSError:
+                    pass  # best-effort: never written, or already gone
             if plane is not None:
                 plane.close()
             hooks.close()

@@ -910,6 +910,54 @@ def run_why(args) -> int:
     return 0
 
 
+def run_chaos(args) -> int:
+    """Randomized chaos campaign (surreal_tpu/chaos/): N seeded
+    multi-site fault schedules executed as short REAL training runs,
+    every run judged by the invariant oracles, failing schedules shrunk
+    to minimal form. Exit 0 only on zero violations — the committed
+    CHAOS_campaign.json this writes is what perf_gate.gate_chaos
+    enforces."""
+    import tempfile
+
+    from surreal_tpu.chaos import campaign as chaos_campaign
+    from surreal_tpu.chaos import schedule as chaos_schedule
+
+    profiles = [
+        p for p, meta in chaos_schedule.PROFILES.items()
+        if args.algo in ("all", meta["algo"])
+    ]
+    if not profiles:
+        print(f"no chaos profile for algo {args.algo!r} "
+              f"(profiles: {sorted(chaos_schedule.PROFILES)})",
+              file=sys.stderr)
+        return 2
+    base_dir = args.dir or tempfile.mkdtemp(prefix="surreal_chaos_")
+    os.makedirs(base_dir, exist_ok=True)
+    env = args.env if args.env not in (None, "default") else None
+    artifact = chaos_campaign.run_campaign(
+        seeds=args.seeds,
+        base_dir=base_dir,
+        profiles=profiles,
+        env=env,
+        max_shrink_runs=args.max_shrink_runs,
+    )
+    if args.out:
+        chaos_campaign.write_artifact(args.out, artifact)
+        print(f"wrote {args.out}")
+    g = artifact["gauges"]
+    print(f"chaos campaign: {int(g['chaos/schedules'])} schedules, "
+          f"{int(g['chaos/sites_covered'])} sites fired, "
+          f"{int(g['chaos/faults_injected'])} faults injected, "
+          f"{int(g['chaos/violations'])} violations "
+          f"({g['chaos/run_ms'] / 1e3:.1f}s)")
+    for fail in artifact["failures"]:
+        print(f"  FAIL seed={fail['seed']} profile={fail['profile']}: "
+              f"minimal plan {json.dumps(fail['minimal_plan'])} "
+              f"(replay: surreal_tpu chaos ... --seeds 1 with this "
+              f"(profile, seed))")
+    return 1 if artifact["failures"] else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="surreal_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -1076,6 +1124,33 @@ def main(argv=None) -> int:
                    help="render one incident in full detail (default: "
                    "all, newest last)")
     w.set_defaults(fn=run_why)
+
+    c = sub.add_parser("chaos", help="randomized chaos campaign: N "
+                       "seeded multi-site fault schedules run as short "
+                       "real training sessions, judged by the run-wide "
+                       "invariant oracles (chaos/invariants.py); "
+                       "failing schedules are shrunk to minimal "
+                       "reproducers")
+    c.add_argument("algo", choices=("impala", "ddpg", "all"),
+                   help="which campaign profiles to run (profile algo "
+                   "family; 'all' interleaves every profile)")
+    c.add_argument("env", nargs="?", default="default",
+                   help="env name override for every profile "
+                   "(default: each profile's own env)")
+    c.add_argument("--seeds", type=int, default=25,
+                   help="number of seeded schedules (seed i -> "
+                   "profile i %% len(profiles); intensity ramps with "
+                   "seed %% 3)")
+    c.add_argument("--out", default=None,
+                   help="write the campaign artifact JSON here "
+                   "(CHAOS_campaign.json for the committed, gated copy)")
+    c.add_argument("--dir", default=None,
+                   help="scratch dir for the runs' session folders "
+                   "(default: a fresh temp dir)")
+    c.add_argument("--max-shrink-runs", type=int, default=12,
+                   help="re-run budget per failing schedule for the "
+                   "greedy shrinker")
+    c.set_defaults(fn=run_chaos)
 
     args = parser.parse_args(argv)
     # the --local-procs supervisor re-issues this exact command per rank

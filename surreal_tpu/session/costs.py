@@ -112,6 +112,11 @@ GAUGE_REGISTRY = {
     "experience/dropped_rows": _g("count",
         "transitions dropped after the sender's bounded retry budget "
         'exhausted against a dead shard.'),
+    "experience/sent_rows": _g("count",
+        "sender-side transitions handed to the wire (watermark units — "
+        're-based to the shard ledger on a re-hello); with ingested + '
+        'dropped + inflight it closes the exactly-once conservation law '
+        'the chaos oracle checks.'),
     # -- serving tier (distributed/fleet.py; fleet aggregates) --------------
     "fleet/replicas_live": _g("count",
         'inference-server replicas currently alive.'),
@@ -386,6 +391,22 @@ GAUGE_REGISTRY = {
     "engine/stage_kills": _g("count",
         'engine.stage kill_stage chaos firings absorbed by the boundary '
         '(the stage crashed; training continued).'),
+    # ---- chaos campaigns (chaos/campaign.py, ISSUE 20) ----
+    "chaos/schedules": _g("count",
+        'seeded multi-site fault schedules executed by this campaign.'),
+    "chaos/violations": _g("count",
+        'invariant-oracle violations across the campaign (the gate '
+        'requires zero in the committed artifact).'),
+    "chaos/faults_injected": _g("count",
+        'fault firings actually delivered across all campaign runs '
+        '(plan entries whose site reached its scheduled call count).'),
+    "chaos/sites_covered": _g("count",
+        'distinct fault sites that FIRED at least once this campaign '
+        '(the artifact gate requires >= 10).'),
+    "chaos/shrink_iters": _g("count",
+        're-runs spent by the greedy shrinker reducing failing '
+        'schedules to minimal form (0 on a clean campaign).'),
+    "chaos/run_ms": _g("ms", 'campaign wall-clock, all runs + shrinking.'),
 }
 
 # Public peak specs per accelerator generation: (peak FLOP/s bf16,

@@ -192,6 +192,15 @@ EVENT_REGISTRY = {
     "param_fetch": "parameter-service fetches (distributed/param_service.py)",
     "serving_tier": "inference-fleet snapshot (distributed/fleet.py)",
     "experience_plane": "sharded experience plane (experience/plane.py)",
+    "experience_close": "final exactly-once row accounting at plane "
+                        "teardown (experience/plane.py::accounting via "
+                        "the drivers' close paths) — the chaos "
+                        "conservation oracle's input",
+    "chaos_campaign": "chaos campaign run summary: seed, profile, plan, "
+                      "oracle verdicts (chaos/campaign.py)",
+    "chaos_violation": "one invariant-oracle violation found by a chaos "
+                       "campaign run, with its (shrunk) schedule "
+                       "(chaos/campaign.py)",
     "gateway": "session gateway tenant snapshot (gateway/server.py)",
     "ops_snapshot": "ops-plane merged-snapshot pointer (session/opsplane.py)",
     "slo_breach": "per-tenant SLO window breach (session/slo.py)",

@@ -166,27 +166,37 @@ import threading
 import time
 from typing import Any
 
-SITES = frozenset(
-    {
-        "trainer.iteration",
-        "engine.stage",
-        "env_worker.step",
-        "transport.send",
-        "server.serve",
-        "param_service.reply",
-        "experience.shard",
-        "experience.sample",
-        "experience.send",
-        "experience.spill",
-        "fleet.replica",
-        "param.publish",
-        "gateway.session",
-        "ops.push",
-        "trace.emit",
-        "watchdog.eval",
-        "lgroup.member",
-    }
-)
+# Per-site kind vocabulary — the machine-readable mirror of the docstring
+# above. FaultInjector validates every plan entry against it (a typo'd kind
+# used to be a silent no-op: the firing was recorded but no handler matched),
+# and the chaos schedule generator (surreal_tpu/chaos/schedule.py) draws
+# from it. Keep in sync with the site handlers; the import-hygiene
+# fault-site lint keeps SITES itself honest against the fire() call sites.
+SITE_KINDS: dict[str, frozenset[str]] = {
+    "trainer.iteration": frozenset({"sigterm", "nan_state", "delay"}),
+    "engine.stage": frozenset({"delay_stage", "kill_stage"}),
+    "env_worker.step": frozenset({"kill_worker", "delay"}),
+    "transport.send": frozenset({"drop_frame", "delay_frame",
+                                 "corrupt_slab"}),
+    "server.serve": frozenset({"delay"}),
+    "param_service.reply": frozenset({"delay_reply"}),
+    "experience.shard": frozenset({"kill_shard", "delay"}),
+    "experience.sample": frozenset({"delay_sample"}),
+    "experience.send": frozenset({"corrupt_wire_frame", "drop_frame",
+                                  "delay_frame"}),
+    "experience.spill": frozenset({"truncate_segment", "enospc",
+                                   "delay_fsync"}),
+    "fleet.replica": frozenset({"kill_replica", "delay"}),
+    "param.publish": frozenset({"delay_publish", "drop_frame"}),
+    "gateway.session": frozenset({"drop_frame", "kill_replica", "delay"}),
+    "ops.push": frozenset({"drop_frame", "delay"}),
+    "trace.emit": frozenset({"drop_span", "delay"}),
+    "watchdog.eval": frozenset({"drop_eval", "delay"}),
+    "lgroup.member": frozenset({"kill_member", "join_member",
+                                "leave_member"}),
+}
+
+SITES = frozenset(SITE_KINDS)
 
 
 class FaultInjected(RuntimeError):
@@ -209,6 +219,11 @@ class FaultInjector:
                 )
             if "kind" not in entry:
                 raise ValueError(f"fault spec {entry!r} has no 'kind'")
+            if entry["kind"] not in SITE_KINDS[site]:
+                raise ValueError(
+                    f"fault kind {entry['kind']!r} unknown for site "
+                    f"{site!r}; kinds: {sorted(SITE_KINDS[site])}"
+                )
             entry["at"] = int(entry.get("at", 0))
             entry["times"] = int(entry.get("times", 1))
             self.plan.append(entry)
@@ -242,6 +257,13 @@ class FaultInjector:
         with self._lock:
             out, self._fired = self._fired, []
         return out
+
+    def counts(self) -> dict[str, int]:
+        """Snapshot of per-site call counts — the chaos campaign's oracle
+        input: a plan entry whose ``at`` is below its site's count MUST
+        have fired (and so must appear as a ``fault`` telemetry event)."""
+        with self._lock:
+            return dict(self._counts)
 
 
 _injector = FaultInjector()

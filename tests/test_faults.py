@@ -179,6 +179,9 @@ def test_seed_dropped_frame_recovers_via_respawn(tmp_path):
     assert metrics["workers/respawns"] >= 1.0
 
 
+# slow: ~18 s; the seed_gateway chaos profile (nan_ok) covers the
+# nan_state-under-serving path in the tier-1 mini-campaign
+@pytest.mark.slow
 def test_seed_nan_state_rolls_back_and_keeps_serving(tmp_path):
     """Forced-NaN state on the SEED path: the guard trips at the metrics
     cadence, the trainer restores the last finite checkpoint, re-arms the

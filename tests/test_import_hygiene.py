@@ -394,7 +394,7 @@ def test_perf_gauges_appear_in_registry():
 
     lit = re.compile(
         r"[\"']((?:perf|replay|experience|fleet|param|gateway|ops|slo"
-        r"|lineage|trace|remediation|loadgen|lgroup|tier|engine)"
+        r"|lineage|trace|remediation|loadgen|lgroup|tier|engine|chaos)"
         r"/[a-z0-9_]+)[\"']"
     )
     bad = []
@@ -410,7 +410,7 @@ def test_perf_gauges_appear_in_registry():
                 )
     assert not bad, (
         "perf/replay/experience/fleet/param/gateway/ops/slo/lineage/trace/"
-        "remediation/loadgen/lgroup/tier/engine gauges emitted "
+        "remediation/loadgen/lgroup/tier/engine/chaos gauges emitted "
         "but not documented in session/costs.py::GAUGE_REGISTRY:\n"
         + "\n".join(bad)
     )
@@ -419,7 +419,8 @@ def test_perf_gauges_appear_in_registry():
         assert name.startswith(
             ("perf/", "replay/", "experience/", "fleet/", "param/",
              "gateway/", "ops/", "slo/", "lineage/", "trace/",
-             "remediation/", "loadgen/", "lgroup/", "tier/", "engine/")
+             "remediation/", "loadgen/", "lgroup/", "tier/", "engine/",
+             "chaos/")
         ), name
 
 
@@ -571,6 +572,70 @@ def test_stage_specs_declare_donation():
         "(state whether the stage's jitted program donates its "
         "loop-carried inputs):\n" + "\n".join(bad)
     )
+
+
+def test_fault_sites_covered_and_registered():
+    """Fault-site coverage lint (ISSUE 20 satellite, the gauge-lint
+    pattern applied to the chaos surface): the injectable-fault registry
+    and the code/tests stay honest in BOTH directions —
+
+    - every ``faults.fire("<site>")`` literal in the package names a
+      registered site (a typo'd site is a fault hook that can never
+      fire, invisible until a campaign claims coverage it doesn't have);
+    - every registered site is exercised somewhere under tests/ (a
+      site literal in a fault plan or chaos profile) — a site nobody
+      injects is dead robustness code;
+    - every site in the chaos generator's SITE_META uses kinds from the
+      site's declared vocabulary, and every campaign profile draws only
+      SITE_META sites (the validation FaultInjector now enforces kinds
+      at run time; this keeps the generator's metadata from drifting
+      ahead of the registry).
+    """
+    import re
+
+    from surreal_tpu.chaos import schedule as chaos_schedule
+    from surreal_tpu.utils.faults import SITE_KINDS, SITES
+
+    fire_lit = re.compile(r"faults\.fire\(\s*\n?\s*[\"']([a-z_.]+)[\"']")
+    bad = []
+    for path in sorted(_PKG_ROOT.rglob("*.py")):
+        src = path.read_text()
+        for m in fire_lit.finditer(src):
+            if m.group(1) not in SITES:
+                line = src.count("\n", 0, m.start()) + 1
+                bad.append(
+                    f"{path.relative_to(_REPO_ROOT)}:{line}: {m.group(1)}"
+                )
+    assert not bad, (
+        "faults.fire() call sites naming unregistered fault sites "
+        "(register in utils/faults.py::SITE_KINDS or fix the typo):\n"
+        + "\n".join(bad)
+    )
+    test_src = "".join(
+        p.read_text() for p in sorted((_REPO_ROOT / "tests").glob("*.py"))
+    )
+    uncovered = [
+        site for site in sorted(SITES)
+        if f'"{site}"' not in test_src and f"'{site}'" not in test_src
+    ]
+    assert not uncovered, (
+        "registered fault sites never exercised by any test fault plan "
+        "or chaos profile:\n" + "\n".join(uncovered)
+    )
+    # generator metadata vs the registry
+    for site, meta in chaos_schedule.SITE_META.items():
+        assert site in SITES, f"SITE_META names unregistered site {site}"
+        for kind in meta["kinds"]:
+            assert kind in SITE_KINDS[site], (
+                f"SITE_META draws kind {kind!r} outside {site}'s "
+                "declared vocabulary"
+            )
+    for name, prof in chaos_schedule.PROFILES.items():
+        for site in prof["sites"]:
+            assert site in chaos_schedule.SITE_META, (
+                f"chaos profile {name} draws site {site} with no "
+                "SITE_META entry"
+            )
 
 
 def test_graft_entry_import_initializes_no_backend():

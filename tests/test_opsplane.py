@@ -356,7 +356,7 @@ def test_ops_plane_chaos_e2e(tmp_path):
             # the budget exhausts mid-run -> the "slo" incident dump
             slo=Config(act_rtt_p99_ms=0.0001, budget_windows=4, budget=0.25),
             faults=Config(plan=[
-                {"site": "fleet.replica", "kind": "kill", "at": 40},
+                {"site": "fleet.replica", "kind": "kill_replica", "at": 40},
                 {"site": "gateway.session", "kind": "delay", "ms": 30,
                  "at": 20, "times": 2},
             ]),

@@ -537,7 +537,7 @@ def test_remediation_chaos_e2e_action_executes_and_renders(tmp_path):
             ),
             remediate=Config(cooldown_s=0.5, verify_windows=2),
             faults=Config(plan=[
-                {"site": "fleet.replica", "kind": "kill", "at": 40},
+                {"site": "fleet.replica", "kind": "kill_replica", "at": 40},
             ]),
         ),
     ).extend(base_config())

@@ -309,6 +309,9 @@ def test_derived_staleness_prefers_exact_lineage_and_slo_consumes_it(tmp_path):
 
 # -- the chaos e2e acceptance run ---------------------------------------------
 
+# slow: ~20 s real run whose fault/trace coverage the chaos
+# mini-campaign (tests/test_chaos.py) now exercises every tier-1 run
+@pytest.mark.slow
 def test_trace_lineage_chaos_e2e(tmp_path):
     """A live SEED run (workers + 2-replica fleet + gateway) with an
     external tenant and a trace.emit chaos drop: the run finishes with

@@ -482,7 +482,7 @@ def test_watchdog_chaos_e2e_incident_names_injected_tier(tmp_path):
 
     folder = str(tmp_path)
     cfg = _chaos_cfg(folder, [
-        {"site": "fleet.replica", "kind": "kill", "at": 40},
+        {"site": "fleet.replica", "kind": "kill_replica", "at": 40},
         {"site": "gateway.session", "kind": "delay", "ms": 30,
          "at": 20, "times": 4},
     ])
